@@ -1,0 +1,136 @@
+"""ray_tpu — a TPU-native distributed computing framework.
+
+A brand-new framework with the capabilities of Ray (tasks, actors, objects,
+placement groups, Train/Tune/Serve/Data/RL libraries), designed TPU-first:
+the resource model speaks TPU chips and pod slices natively, worker groups
+bootstrap ``jax.distributed`` + MEGASCALE instead of NCCL rendezvous, and all
+hot-path parallelism is expressed as GSPMD/``shard_map`` shardings over ICI.
+
+Public API mirrors the reference (python/ray/__init__.py) where it makes
+sense: ``init, shutdown, remote, get, put, wait, kill, cancel, get_actor``.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any
+
+from ray_tpu._version import version as __version__
+from ray_tpu._private.ids import (
+    ActorID,
+    JobID,
+    NodeID,
+    ObjectID,
+    PlacementGroupID,
+    TaskID,
+    UniqueID,
+    WorkerID,
+)
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.worker import (
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    shutdown,
+    wait,
+)
+from ray_tpu.actor import ActorClass, ActorHandle, method
+from ray_tpu.remote_function import RemoteFunction
+from ray_tpu.runtime_context import get_runtime_context
+from ray_tpu import exceptions  # noqa: F401
+
+_ALLOWED_TASK_OPTIONS = {
+    "num_returns",
+    "num_cpus",
+    "num_gpus",
+    "num_tpus",
+    "memory",
+    "resources",
+    "max_retries",
+    "retry_exceptions",
+    "scheduling_strategy",
+    "runtime_env",
+    "name",
+    "max_calls",
+}
+_ALLOWED_ACTOR_OPTIONS = {
+    "num_cpus",
+    "num_gpus",
+    "num_tpus",
+    "memory",
+    "resources",
+    "max_restarts",
+    "max_task_retries",
+    "max_concurrency",
+    "max_pending_calls",
+    "name",
+    "namespace",
+    "lifetime",
+    "get_if_exists",
+    "scheduling_strategy",
+    "runtime_env",
+}
+
+
+def remote(*args, **kwargs):
+    """``@ray_tpu.remote`` — turn a function into a RemoteFunction or a class
+    into an ActorClass (reference: python/ray/_private/worker.py:3391)."""
+
+    def _make(target):
+        if inspect.isclass(target):
+            bad = set(kwargs) - _ALLOWED_ACTOR_OPTIONS
+            if bad:
+                raise ValueError(f"Invalid actor options: {sorted(bad)}")
+            return ActorClass(target, kwargs)
+        if callable(target):
+            bad = set(kwargs) - _ALLOWED_TASK_OPTIONS
+            if bad:
+                raise ValueError(f"Invalid task options: {sorted(bad)}")
+            return RemoteFunction(target, kwargs)
+        raise TypeError("@ray_tpu.remote requires a function or class")
+
+    if len(args) == 1 and not kwargs and (callable(args[0]) or inspect.isclass(args[0])):
+        return _make(args[0])
+    if args:
+        raise TypeError("@ray_tpu.remote accepts only keyword options")
+    return _make
+
+
+__all__ = [
+    "__version__",
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "cancel",
+    "get_actor",
+    "nodes",
+    "cluster_resources",
+    "available_resources",
+    "get_runtime_context",
+    "method",
+    "ObjectRef",
+    "ActorClass",
+    "ActorHandle",
+    "RemoteFunction",
+    "exceptions",
+    "ActorID",
+    "JobID",
+    "NodeID",
+    "ObjectID",
+    "PlacementGroupID",
+    "TaskID",
+    "UniqueID",
+    "WorkerID",
+]

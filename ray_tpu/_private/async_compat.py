@@ -1,0 +1,26 @@
+"""Bridging ObjectRefs into asyncio (reference: python/ray/_private/async_compat.py)."""
+
+from __future__ import annotations
+
+import asyncio
+
+
+def as_asyncio_future(ref) -> "asyncio.Future":
+    loop = asyncio.get_event_loop()
+    aio_fut: asyncio.Future = loop.create_future()
+
+    from ray_tpu._private import worker as _worker_mod
+
+    cf = _worker_mod.global_worker.core.as_future(ref)
+
+    def _done(f):
+        if aio_fut.cancelled():
+            return
+        exc = f.exception()
+        if exc is not None:
+            loop.call_soon_threadsafe(aio_fut.set_exception, exc)
+        else:
+            loop.call_soon_threadsafe(aio_fut.set_result, f.result())
+
+    cf.add_done_callback(_done)
+    return aio_fut

@@ -1,0 +1,26 @@
+"""ClusterRuntime — the real multi-process execution backend.
+
+Reference analogue: the Cython CoreWorker (python/ray/_raylet.pyx:2851) over
+src/ray/core_worker/, talking to a raylet (src/ray/raylet/) and GCS
+(src/ray/gcs/). Composed of:
+
+- GCS server process (ray_tpu/_private/gcs/): node/actor/KV/job tables,
+  pubsub, health checks.
+- Raylet process per node (ray_tpu/_private/raylet/): worker pool, local
+  scheduler with TPU-aware resources, lease protocol.
+- Shared-memory object store (src/object_store/, C++): plasma-equivalent.
+- Worker processes executing tasks/actors.
+
+Under construction — milestone 2 of round 1.
+"""
+
+from __future__ import annotations
+
+
+class ClusterRuntime:
+    @classmethod
+    def create(cls, **kwargs):
+        raise NotImplementedError(
+            "Cluster mode is under construction in this round; "
+            "use ray_tpu.init(local_mode=True) meanwhile."
+        )

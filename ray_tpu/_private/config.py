@@ -1,0 +1,120 @@
+"""Flag/config system for ray_tpu.
+
+TPU-native equivalent of the reference's ``RAY_CONFIG(type, name, default)``
+macro table (reference: src/ray/common/ray_config_def.h:18 — 244 flags,
+overridable via ``RAY_<name>`` env vars and a ``_system_config`` dict at init).
+
+Here every flag is declared once in ``_DEFINITIONS`` with a type and default,
+is overridable via the ``RAY_TPU_<NAME>`` environment variable, and can be
+overridden programmatically via ``RayTpuConfig.initialize(system_config)``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, Tuple
+
+
+def _parse_bool(v: str) -> bool:
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+# name -> (type, default)
+_DEFINITIONS: Dict[str, Tuple[type, Any]] = {
+    # --- event loop / rpc ---
+    "rpc_connect_timeout_s": (float, 10.0),
+    "rpc_call_timeout_s": (float, 60.0),
+    "rpc_retry_base_delay_ms": (int, 100),
+    "rpc_retry_max_delay_ms": (int, 5000),
+    "rpc_max_retries": (int, 5),
+    "event_loop_slow_handler_ms": (int, 100),
+    # --- chaos / fault injection (reference: src/ray/rpc/rpc_chaos.h:8,
+    # src/ray/asio/asio_chaos.h) ---
+    "testing_rpc_failure": (str, ""),  # "method=prob" comma list
+    "testing_asio_delay_us": (str, ""),
+    # --- gcs ---
+    "gcs_port": (int, 0),  # 0 = pick free port
+    "gcs_storage_path": (str, ""),  # "" = in-memory; else file-backed persistence
+    "gcs_health_check_period_ms": (int, 1000),
+    "gcs_health_check_timeout_ms": (int, 5000),
+    "gcs_health_check_failure_threshold": (int, 5),
+    "gcs_pubsub_poll_timeout_s": (float, 30.0),
+    # --- raylet / scheduler ---
+    "raylet_heartbeat_period_ms": (int, 500),
+    "worker_lease_timeout_ms": (int, 30000),
+    "worker_pool_prestart_workers": (bool, False),
+    "worker_idle_timeout_s": (float, 60.0),
+    "max_workers_per_node": (int, 64),
+    "scheduler_top_k_fraction": (float, 0.2),
+    "scheduler_top_k_absolute": (int, 1),
+    "scheduler_spread_threshold": (float, 0.5),
+    "worker_startup_timeout_s": (float, 60.0),
+    # --- object store ---
+    "object_store_memory_bytes": (int, 2 * 1024**3),
+    "object_store_socket": (str, ""),
+    "object_spilling_dir": (str, ""),
+    "object_store_full_delay_ms": (int, 100),
+    "object_store_inline_max_bytes": (int, 100 * 1024),
+    "object_pull_chunk_bytes": (int, 8 * 1024**2),
+    # --- tasks ---
+    "task_max_retries_default": (int, 3),
+    "actor_max_restarts_default": (int, 0),
+    "max_pending_lease_requests_per_class": (int, 10),
+    # --- tpu ---
+    "tpu_chips_per_host_default": (int, 4),
+    "megascale_port": (int, 8081),
+    "jax_coordinator_port": (int, 8476),
+    # --- logging ---
+    "log_dir": (str, ""),
+    "log_to_driver": (bool, True),
+}
+
+
+class _Config:
+    """Singleton holding resolved config values."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._values: Dict[str, Any] = {}
+        self._load_defaults()
+
+    def _load_defaults(self) -> None:
+        for name, (typ, default) in _DEFINITIONS.items():
+            env = os.environ.get("RAY_TPU_" + name.upper())
+            if env is not None:
+                if typ is bool:
+                    self._values[name] = _parse_bool(env)
+                else:
+                    self._values[name] = typ(env)
+            else:
+                self._values[name] = default
+
+    def initialize(self, system_config: Dict[str, Any] | None = None) -> None:
+        """Apply a programmatic override dict (like Ray's _system_config)."""
+        with self._lock:
+            self._load_defaults()
+            if system_config:
+                for k, v in system_config.items():
+                    if k not in _DEFINITIONS:
+                        raise ValueError(f"Unknown config flag: {k}")
+                    typ = _DEFINITIONS[k][0]
+                    self._values[k] = _parse_bool(v) if typ is bool and isinstance(v, str) else typ(v)
+
+    def to_json(self) -> str:
+        with self._lock:
+            return json.dumps(self._values)
+
+    def from_json(self, payload: str) -> None:
+        with self._lock:
+            self._values.update(json.loads(payload))
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self._values[name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+
+config = _Config()

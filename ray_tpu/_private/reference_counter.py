@@ -1,0 +1,122 @@
+"""Distributed reference counting (per-worker part).
+
+Reference: src/ray/core_worker/reference_counter.h:44. Each worker tracks,
+per ObjectID: local refcount (live ObjectRef pythons), submitted-task count
+(refs in flight as pending task args), and borrower state. The *owner* of an
+object (the worker that created it) additionally tracks borrowers and frees
+the object from the store when the global count reaches zero.
+
+Round-1 scope: correct local counting + owner-side free callbacks +
+borrower registration via RPC hooks the cluster runtime installs. Lineage
+pinning hooks are present (``set_lineage_pinned``) for reconstruction.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional, Set, Tuple
+
+from ray_tpu._private.ids import ObjectID
+
+
+@dataclass
+class _Ref:
+    local_refs: int = 0
+    submitted_task_refs: int = 0
+    borrowers: Set[Tuple[str, int]] = field(default_factory=set)
+    owned: bool = False
+    lineage_pinned: bool = False
+    pending_creation: bool = False
+
+
+class ReferenceCounter:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._refs: Dict[ObjectID, _Ref] = {}
+        # called when an *owned* object's global count hits zero
+        self._on_zero: Optional[Callable[[ObjectID], None]] = None
+        self._frozen = False
+
+    def set_on_zero_callback(self, cb: Callable[[ObjectID], None]) -> None:
+        self._on_zero = cb
+
+    def freeze(self) -> None:
+        """Stop issuing on-zero callbacks (during shutdown)."""
+        self._frozen = True
+
+    # -- ownership --------------------------------------------------------
+    def add_owned_object(self, oid: ObjectID, pending_creation: bool = False) -> None:
+        with self._lock:
+            r = self._refs.setdefault(oid, _Ref())
+            r.owned = True
+            r.pending_creation = pending_creation
+
+    def is_owned(self, oid: ObjectID) -> bool:
+        with self._lock:
+            r = self._refs.get(oid)
+            return bool(r and r.owned)
+
+    def set_lineage_pinned(self, oid: ObjectID, pinned: bool) -> None:
+        with self._lock:
+            r = self._refs.get(oid)
+            if r:
+                r.lineage_pinned = pinned
+
+    # -- local counting ---------------------------------------------------
+    def add_local_reference(self, oid: ObjectID) -> None:
+        with self._lock:
+            self._refs.setdefault(oid, _Ref()).local_refs += 1
+
+    def remove_local_reference(self, oid: ObjectID) -> None:
+        with self._lock:
+            r = self._refs.get(oid)
+            if r is None:
+                return
+            r.local_refs -= 1
+            self._maybe_release(oid, r)
+
+    def add_submitted_task_ref(self, oid: ObjectID) -> None:
+        with self._lock:
+            self._refs.setdefault(oid, _Ref()).submitted_task_refs += 1
+
+    def remove_submitted_task_ref(self, oid: ObjectID) -> None:
+        with self._lock:
+            r = self._refs.get(oid)
+            if r is None:
+                return
+            r.submitted_task_refs -= 1
+            self._maybe_release(oid, r)
+
+    # -- borrowers (installed by cluster runtime) -------------------------
+    def add_borrower(self, oid: ObjectID, borrower_addr: Tuple[str, int]) -> None:
+        with self._lock:
+            self._refs.setdefault(oid, _Ref()).borrowers.add(borrower_addr)
+
+    def remove_borrower(self, oid: ObjectID, borrower_addr: Tuple[str, int]) -> None:
+        with self._lock:
+            r = self._refs.get(oid)
+            if r is None:
+                return
+            r.borrowers.discard(borrower_addr)
+            self._maybe_release(oid, r)
+
+    # -- internal ---------------------------------------------------------
+    def _maybe_release(self, oid: ObjectID, r: _Ref) -> None:
+        if r.local_refs <= 0 and r.submitted_task_refs <= 0 and not r.borrowers:
+            owned = r.owned
+            pinned = r.lineage_pinned
+            del self._refs[oid]
+            if owned and not pinned and self._on_zero and not self._frozen:
+                try:
+                    self._on_zero(oid)
+                except Exception:
+                    pass
+
+    def num_tracked(self) -> int:
+        with self._lock:
+            return len(self._refs)
+
+    def has_reference(self, oid: ObjectID) -> bool:
+        with self._lock:
+            return oid in self._refs

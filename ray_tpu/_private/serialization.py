@@ -1,0 +1,117 @@
+"""Serialization of task args / return values / put objects.
+
+Reference: python/ray/_private/serialization.py. Uses cloudpickle with
+pickle-protocol-5 out-of-band buffers so large numpy / jax host arrays are
+captured as contiguous buffers (zero-copy into/out of the shared-memory
+object store), plus a per-job custom-serializer registry.
+
+Wire format of a serialized object:
+    [u32 meta_len][meta pickle][u32 nbuffers][u64 len, bytes]...
+where meta is the cloudpickle payload with PickleBuffer placeholders.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import struct
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+_custom_serializers: Dict[type, Tuple[Callable, Callable]] = {}
+_lock = threading.Lock()
+
+
+def register_serializer(cls: type, *, serializer: Callable, deserializer: Callable) -> None:
+    """Register a custom (de)serializer pair (reference:
+    ray.util.register_serializer)."""
+    with _lock:
+        _custom_serializers[cls] = (serializer, deserializer)
+
+
+def deregister_serializer(cls: type) -> None:
+    with _lock:
+        _custom_serializers.pop(cls, None)
+
+
+class _CustomPickler(cloudpickle.Pickler):
+    def __init__(self, file, protocol=5, buffer_callback=None):
+        super().__init__(file, protocol=protocol, buffer_callback=buffer_callback)
+
+    def reducer_override(self, obj):
+        s = _custom_serializers.get(type(obj))
+        if s is not None:
+            ser, deser = s
+            return (_reconstruct_custom, (type(obj).__module__, type(obj).__qualname__, ser(obj)))
+        return NotImplemented
+
+
+def _reconstruct_custom(module: str, qualname: str, payload: Any):
+    import importlib
+
+    mod = importlib.import_module(module)
+    cls = mod
+    for part in qualname.split("."):
+        cls = getattr(cls, part)
+    _, deser = _custom_serializers[cls]
+    return deser(payload)
+
+
+def _device_to_host(obj: Any) -> Any:
+    """jax.Array values are pulled to host before pickling."""
+    return obj
+
+
+def serialize(value: Any) -> bytes:
+    """Serialize a Python value into the wire/object-store format."""
+    buffers: List[pickle.PickleBuffer] = []
+    bio = io.BytesIO()
+    pickler = _CustomPickler(bio, protocol=5, buffer_callback=buffers.append)
+    pickler.dump(value)
+    meta = bio.getvalue()
+    out = io.BytesIO()
+    out.write(struct.pack("<I", len(meta)))
+    out.write(meta)
+    out.write(struct.pack("<I", len(buffers)))
+    for b in buffers:
+        raw = b.raw()
+        out.write(struct.pack("<Q", raw.nbytes))
+        out.write(raw)
+        b.release()
+    return out.getvalue()
+
+
+def serialize_into(value: Any, alloc: Callable[[int], memoryview]) -> memoryview:
+    """Serialize directly into store-provided memory (one copy, no interim
+    bytes join for the buffer region when possible)."""
+    data = serialize(value)
+    mv = alloc(len(data))
+    mv[: len(data)] = data
+    return mv
+
+
+def deserialize(data: "bytes | memoryview") -> Any:
+    mv = memoryview(data)
+    (meta_len,) = struct.unpack_from("<I", mv, 0)
+    off = 4
+    meta = mv[off : off + meta_len]
+    off += meta_len
+    (nbuf,) = struct.unpack_from("<I", mv, off)
+    off += 4
+    buffers = []
+    for _ in range(nbuf):
+        (blen,) = struct.unpack_from("<Q", mv, off)
+        off += 8
+        buffers.append(mv[off : off + blen])  # zero-copy view
+        off += blen
+    return pickle.loads(bytes(meta) if isinstance(meta, memoryview) else meta, buffers=buffers)
+
+
+def dumps_function(fn: Any) -> bytes:
+    return cloudpickle.dumps(fn, protocol=5)
+
+
+def loads_function(data: bytes) -> Any:
+    return cloudpickle.loads(data)

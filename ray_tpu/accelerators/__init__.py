@@ -1,0 +1,14 @@
+from ray_tpu.accelerators.accelerator import AcceleratorManager
+from ray_tpu.accelerators.tpu import TPUAcceleratorManager
+
+_MANAGERS = {
+    "TPU": TPUAcceleratorManager,
+}
+
+
+def get_accelerator_manager(resource_name: str):
+    return _MANAGERS.get(resource_name)
+
+
+def get_all_accelerator_managers():
+    return dict(_MANAGERS)

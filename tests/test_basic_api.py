@@ -1,0 +1,180 @@
+"""Core API tests in local mode (reference test model: python/ray/tests/test_basic.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import GetTimeoutError
+
+
+def test_ids_roundtrip():
+    from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID
+
+    job = JobID.from_int(7)
+    tid = TaskID.for_normal_task(job)
+    assert tid.job_id() == job
+    oid = ObjectID.from_index(tid, 3)
+    assert oid.task_id() == tid
+    assert oid.index() == 3
+    aid = ActorID.of(job)
+    assert aid.job_id() == job
+    ct = TaskID.for_actor_creation(aid)
+    assert ct.actor_id() == aid
+
+
+def test_put_get(ray_start_local):
+    ref = ray_tpu.put({"a": 1})
+    assert ray_tpu.get(ref) == {"a": 1}
+    refs = [ray_tpu.put(i) for i in range(10)]
+    assert ray_tpu.get(refs) == list(range(10))
+
+
+def test_task_submit(ray_start_local):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+    # chained refs as args
+    r = add.remote(add.remote(1, 1), add.remote(2, 2))
+    assert ray_tpu.get(r) == 6
+
+
+def test_task_multiple_returns(ray_start_local):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_error_propagates(ray_start_local):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("boom!")
+
+    with pytest.raises(ValueError, match="boom!"):
+        ray_tpu.get(boom.remote())
+
+
+def test_get_timeout(ray_start_local):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return 1
+
+    with pytest.raises(GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.1)
+
+
+def test_wait(ray_start_local):
+    @ray_tpu.remote
+    def sleepy(t):
+        time.sleep(t)
+        return t
+
+    fast = sleepy.remote(0.01)
+    slow = sleepy.remote(2.0)
+    ready, not_ready = ray_tpu.wait([fast, slow], num_returns=1, timeout=1.0)
+    assert ready == [fast]
+    assert not_ready == [slow]
+
+
+def test_actor_basic(ray_start_local):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.v = start
+
+        def incr(self, by=1):
+            self.v += by
+            return self.v
+
+        def value(self):
+            return self.v
+
+    c = Counter.remote(10)
+    assert ray_tpu.get(c.incr.remote()) == 11
+    assert ray_tpu.get(c.incr.remote(5)) == 16
+    assert ray_tpu.get(c.value.remote()) == 16
+
+
+def test_actor_ordering(ray_start_local):
+    @ray_tpu.remote
+    class Appender:
+        def __init__(self):
+            self.log = []
+
+        def add(self, x):
+            self.log.append(x)
+            return list(self.log)
+
+    a = Appender.remote()
+    refs = [a.add.remote(i) for i in range(20)]
+    final = ray_tpu.get(refs[-1])
+    assert final == list(range(20))
+
+
+def test_named_actor(ray_start_local):
+    @ray_tpu.remote
+    class Svc:
+        def ping(self):
+            return "pong"
+
+    Svc.options(name="svc").remote()
+    h = ray_tpu.get_actor("svc")
+    assert ray_tpu.get(h.ping.remote()) == "pong"
+    with pytest.raises(ValueError):
+        Svc.options(name="svc").remote()
+
+
+def test_kill_actor(ray_start_local):
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return 1
+
+    a = A.remote()
+    assert ray_tpu.get(a.ping.remote()) == 1
+    ray_tpu.kill(a)
+    with pytest.raises(ray_tpu.exceptions.RayActorError):
+        ray_tpu.get(a.ping.remote())
+
+
+def test_actor_error(ray_start_local):
+    @ray_tpu.remote
+    class B:
+        def bad(self):
+            raise RuntimeError("actor oops")
+
+    b = B.remote()
+    with pytest.raises(RuntimeError, match="actor oops"):
+        ray_tpu.get(b.bad.remote())
+
+
+def test_remote_rejects_direct_call(ray_start_local):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(TypeError):
+        f()
+
+
+def test_cluster_resources(ray_start_local):
+    res = ray_tpu.cluster_resources()
+    assert res["CPU"] >= 1
+    assert res.get("TPU") == 4  # RAY_TPU_FAKE_CHIPS in conftest
+
+
+def test_serialization_numpy_roundtrip(ray_start_local):
+    import numpy as np
+
+    from ray_tpu._private.serialization import deserialize, serialize
+
+    x = np.arange(1024, dtype=np.float32).reshape(32, 32)
+    data = serialize(x)
+    y = deserialize(data)
+    assert (x == y).all()

@@ -1,0 +1,54 @@
+"""DAG API tests (reference test model: python/ray/dag/tests)."""
+
+import ray_tpu
+from ray_tpu.dag import InputNode
+
+
+def test_function_dag(ray_start_local):
+    @ray_tpu.remote
+    def a(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def b(x):
+        return x * 2
+
+    dag = b.bind(a.bind(10))
+    assert ray_tpu.get(dag.execute()) == 22
+
+
+def test_input_node(ray_start_local):
+    @ray_tpu.remote
+    def double(x):
+        return 2 * x
+
+    with InputNode() as inp:
+        dag = double.bind(double.bind(inp))
+    assert ray_tpu.get(dag.execute(5)) == 20
+    assert ray_tpu.get(dag.execute(7)) == 28
+
+
+def test_actor_dag(ray_start_local):
+    @ray_tpu.remote
+    class Adder:
+        def __init__(self, base):
+            self.base = base
+
+        def add(self, x):
+            return self.base + x
+
+    node = Adder.bind(100)
+    dag = node.add.bind(23)
+    assert ray_tpu.get(dag.execute()) == 123
+
+
+def test_method_decorator_num_returns(ray_start_local):
+    @ray_tpu.remote
+    class M:
+        @ray_tpu.method(num_returns=2)
+        def two(self):
+            return 1, 2
+
+    m = M.remote()
+    a, b = m.two.remote()
+    assert ray_tpu.get([a, b]) == [1, 2]
