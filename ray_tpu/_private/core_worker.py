@@ -1,0 +1,865 @@
+"""CoreWorker — per-process runtime for the multi-process cluster backend.
+
+Reference: src/ray/core_worker/core_worker.h:168 (CoreWorker) and its
+submodules: NormalTaskSubmitter (task_submission/normal_task_submitter.h:87,
+lease caching + OnWorkerIdle), TaskManager (task_manager.h:195 — retries,
+completion), ReferenceCounter (reference_counter.h:44), memory store
+(memory_store.h:48), plasma provider (plasma_store_provider.h:94),
+ActorTaskSubmitter (actor_task_submitter.h:69 — seqno ordering).
+
+Every process (driver or executor worker) owns one CoreWorker: it serves
+owner RPCs (GetObject — the ownership model's data path), submits tasks via
+raylet leases, and resolves objects from {memory store, shared-memory store,
+remote owner}.
+
+Object entry formats in the owner memory store:
+    ("inline", bytes)   — serialized value (may deserialize to RayTaskError)
+    ("plasma", node_id) — sealed in the node's shared-memory store
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.config import config
+from ray_tpu._private.core import ActorOptions, CoreRuntime, TaskOptions
+from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID
+from ray_tpu._private.memory_store import MemoryStore
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.object_store.client import StoreClient
+from ray_tpu._private.rpc import (
+    EventLoopThread,
+    RemoteError,
+    RpcClient,
+    RpcConnectionError,
+    RpcServer,
+    get_client,
+)
+from ray_tpu._private.serialization import deserialize, serialize
+from ray_tpu._private.task_spec import (
+    FunctionDescriptor,
+    SchedulingStrategy,
+    TaskArg,
+    TaskSpec,
+    TaskType,
+)
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    ActorUnavailableError,
+    GetTimeoutError,
+    ObjectLostError,
+    RayActorError,
+    RayTaskError,
+    TaskCancelledError,
+    WorkerCrashedError,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class _LeaseEntry:
+    __slots__ = ("lease_id", "worker_addr", "busy", "last_used")
+
+    def __init__(self, lease_id: str, worker_addr: Tuple[str, int]):
+        self.lease_id = lease_id
+        self.worker_addr = worker_addr
+        self.busy = False
+        self.last_used = time.monotonic()
+
+
+class CoreWorker(CoreRuntime):
+    def __init__(
+        self,
+        gcs_addr: Tuple[str, int],
+        raylet_addr: Tuple[str, int],
+        store_socket: str,
+        node_id: str,
+        job_id: JobID,
+        is_driver: bool,
+        worker_id_hex: Optional[str] = None,
+    ):
+        self.gcs_addr = gcs_addr
+        self.raylet_addr = raylet_addr
+        self.node_id = node_id
+        self.job_id = job_id
+        self.is_driver = is_driver
+        self.worker_id_hex = worker_id_hex or uuid.uuid4().hex
+
+        self.loop_thread = EventLoopThread(name="core-worker-io")
+        self.gcs = RpcClient(gcs_addr[0], gcs_addr[1], self.loop_thread)
+        self.raylet = RpcClient(raylet_addr[0], raylet_addr[1], self.loop_thread)
+        self.plasma = StoreClient(store_socket)
+        self.memory_store = MemoryStore()
+        self._plasma_pins: Dict[ObjectID, memoryview] = {}
+        self._pin_lock = threading.Lock()
+
+        # owner RPC server (GetObject / WaitObject / health)
+        self.server = RpcServer(name=f"core-{self.worker_id_hex[:8]}")
+        self.server.register("GetObject", self._handle_get_object)
+        self.server.register("WaitObject", self._handle_wait_object)
+        self.server.register("RemoveBorrower", self._handle_remove_borrower)
+        self.server.register("Ping", lambda: "pong")
+        self.server.start(self.loop_thread)
+        self.address: Tuple[str, int] = (self.server.host, self.server.port)
+
+        # task submission state
+        self._lock = threading.Lock()
+        self._leases: Dict[Any, List[_LeaseEntry]] = {}  # scheduling_class -> entries
+        self._lease_requests_inflight: Dict[Any, int] = {}
+        self._task_queue: Dict[Any, List[TaskSpec]] = {}
+        self._pending_tasks: Dict[TaskID, Dict[str, Any]] = {}
+        # actor state
+        self._actor_addr_cache: Dict[str, Tuple[Tuple[str, int], int]] = {}  # id -> (addr, version)
+        self._actor_seqno: Dict[str, int] = {}
+        self._actor_seq_lock = threading.Lock()
+
+        # blocked-in-get tracking (CPU release protocol, see get())
+        self._blocked_depth = 0
+        self._blocked_lock = threading.Lock()
+
+        self._shutdown = False
+
+    # ==================================================================
+    # Owner-side object services
+    # ==================================================================
+    def _handle_get_object(self, object_id_bin: bytes) -> dict:
+        oid = ObjectID(object_id_bin)
+        e = self.memory_store.get_if_exists(oid)
+        if e is None:
+            return {"status": "pending"}
+        kind = e.value[0]
+        if kind == "inline":
+            return {"status": "inline", "data": e.value[1]}
+        return {"status": "plasma", "node_id": e.value[1]}
+
+    def _handle_wait_object(self, object_id_bin: bytes, timeout_s: float = 10.0) -> dict:
+        oid = ObjectID(object_id_bin)
+        f = self.memory_store.as_future(oid)
+        try:
+            f.result(timeout=timeout_s)
+        except Exception:
+            pass
+        return self._handle_get_object(object_id_bin)
+
+    def _handle_remove_borrower(self, object_id_bin: bytes, borrower: Tuple[str, int]) -> dict:
+        w = worker_mod.global_worker
+        if w is not None:
+            w.reference_counter.remove_borrower(ObjectID(object_id_bin), tuple(borrower))
+        return {"ok": True}
+
+    # ==================================================================
+    # Objects
+    # ==================================================================
+    def _ref_counter(self):
+        return worker_mod.global_worker.reference_counter
+
+    def put(self, value: Any) -> ObjectRef:
+        w = worker_mod.global_worker
+        oid = ObjectID.from_index(w.current_task_id, w.next_put_index())
+        self.put_serialized(oid, serialize(value))
+        self._ref_counter().add_owned_object(oid)
+        return ObjectRef(oid, owner_addr=self.address)
+
+    def put_serialized(self, oid: ObjectID, data: bytes) -> None:
+        if len(data) <= config.object_store_inline_max_bytes:
+            self.memory_store.put(oid, ("inline", data))
+        else:
+            try:
+                self.plasma.put_bytes(oid, data)
+            except FileExistsError:
+                pass
+            self.memory_store.put(oid, ("plasma", self.node_id))
+
+    def _deserialize_entry(self, oid: ObjectID, entry_value: tuple) -> Any:
+        kind = entry_value[0]
+        if kind == "inline":
+            val = deserialize(entry_value[1])
+        else:  # plasma
+            [view] = self.plasma.get([oid], timeout_ms=int(config.rpc_call_timeout_s * 1000))
+            if view is None:
+                raise ObjectLostError(f"object {oid.hex()} not in local store")
+            with self._pin_lock:
+                if oid not in self._plasma_pins:
+                    self._plasma_pins[oid] = view
+                else:
+                    self.plasma.release(oid)
+                    view = self._plasma_pins[oid]
+            val = deserialize(view)
+        if isinstance(val, RayTaskError):
+            raise val.as_instanceof_cause()
+        if isinstance(val, BaseException):
+            raise val
+        return val
+
+    def _get_one(self, ref: ObjectRef, deadline: Optional[float]) -> Any:
+        oid = ref.id()
+        while True:
+            e = self.memory_store.get_if_exists(oid)
+            if e is not None:
+                return self._deserialize_entry(oid, e.value)
+            # do we own it (pending task) or borrow it?
+            owned = self._ref_counter().is_owned(oid)
+            if owned:
+                timeout = None if deadline is None else max(0.0, deadline - time.monotonic())
+                f = self.memory_store.as_future(oid)
+                try:
+                    f.result(timeout=timeout)
+                except TimeoutError:
+                    raise GetTimeoutError(f"Get timed out for {oid.hex()}")
+                continue
+            # borrowed: check local plasma first (e.g. same-node producer)
+            if self.plasma.contains(oid):
+                return self._deserialize_entry(oid, ("plasma", self.node_id))
+            owner = ref.owner_address
+            if owner is None:
+                # last resort: blocking plasma wait
+                [view] = self.plasma.get([oid], timeout_ms=1000)
+                if view is not None:
+                    self.plasma.release(oid)
+                    return self._deserialize_entry(oid, ("plasma", self.node_id))
+                if deadline is not None and time.monotonic() > deadline:
+                    raise GetTimeoutError(f"Get timed out for {oid.hex()} (no owner known)")
+                continue
+            client = get_client(tuple(owner))
+            wait_s = 10.0 if deadline is None else min(10.0, max(0.1, deadline - time.monotonic()))
+            try:
+                reply = client.call("WaitObject", object_id_bin=oid.binary(), timeout_s=wait_s)
+            except (RpcConnectionError, ConnectionError, OSError) as e2:
+                raise ObjectLostError(
+                    f"owner of {oid.hex()} at {owner} is unreachable: {e2}"
+                ) from None
+            if reply["status"] == "inline":
+                val = deserialize(reply["data"])
+                if isinstance(val, RayTaskError):
+                    raise val.as_instanceof_cause()
+                if isinstance(val, BaseException):
+                    raise val
+                return val
+            if reply["status"] == "plasma":
+                return self._deserialize_entry(oid, ("plasma", reply["node_id"]))
+            if deadline is not None and time.monotonic() > deadline:
+                raise GetTimeoutError(f"Get timed out for {oid.hex()}")
+
+    def _maybe_notify_blocked(self, refs: Sequence[ObjectRef]) -> bool:
+        """Executor workers blocked in get() hand their CPU back to the
+        raylet so dependent tasks can run (reference: NotifyDirectCallTask
+        Blocked/Unblocked — avoids nested-task deadlock)."""
+        if self.is_driver:
+            return False
+        w = worker_mod.global_worker
+        lease_id = getattr(w, "current_lease_id", None)
+        if lease_id is None:
+            return False
+        if all(
+            self.memory_store.contains(r.id()) or self.plasma.contains(r.id()) for r in refs
+        ):
+            return False
+        with self._blocked_lock:
+            self._blocked_depth += 1
+            first = self._blocked_depth == 1
+        if first:
+            try:
+                self.raylet.call("NotifyWorkerBlocked", lease_id=lease_id, timeout=5)
+            except Exception:
+                pass
+        return True
+
+    def _notify_unblocked(self) -> None:
+        w = worker_mod.global_worker
+        lease_id = getattr(w, "current_lease_id", None)
+        with self._blocked_lock:
+            self._blocked_depth -= 1
+            last = self._blocked_depth == 0
+        if last and lease_id:
+            try:
+                self.raylet.call("NotifyWorkerUnblocked", lease_id=lease_id, timeout=5)
+            except Exception:
+                pass
+
+    def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        notified = self._maybe_notify_blocked(refs)
+        try:
+            return [self._get_one(r, deadline) for r in refs]
+        finally:
+            if notified:
+                self._notify_unblocked()
+
+    def wait(self, refs, num_returns, timeout, fetch_local=True):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        pending = list(refs)
+        ready: List[ObjectRef] = []
+        while True:
+            still: List[ObjectRef] = []
+            for r in pending:
+                if self.memory_store.contains(r.id()) or self.plasma.contains(r.id()):
+                    ready.append(r)
+                elif not self._ref_counter().is_owned(r.id()) and r.owner_address:
+                    try:
+                        reply = get_client(tuple(r.owner_address)).call(
+                            "GetObject", object_id_bin=r.id().binary(), timeout=5
+                        )
+                        if reply["status"] != "pending":
+                            ready.append(r)
+                        else:
+                            still.append(r)
+                    except Exception:
+                        still.append(r)
+                else:
+                    still.append(r)
+            pending = still
+            if len(ready) >= num_returns or not pending:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(0.01)
+        ready = ready[:num_returns]
+        ready_ids = {r.id() for r in ready}
+        not_ready = [r for r in refs if r.id() not in ready_ids]
+        return ready, not_ready
+
+    def as_future(self, ref: ObjectRef) -> Future:
+        out: Future = Future()
+
+        def _bg():
+            try:
+                out.set_result(self._get_one(ref, None))
+            except BaseException as e:  # noqa: BLE001
+                out.set_exception(e)
+
+        threading.Thread(target=_bg, daemon=True).start()
+        return out
+
+    def free_object(self, oid: ObjectID) -> None:
+        e = self.memory_store.get_if_exists(oid)
+        self.memory_store.delete(oid)
+        with self._pin_lock:
+            if oid in self._plasma_pins:
+                del self._plasma_pins[oid]
+                try:
+                    self.plasma.release(oid)
+                except Exception:
+                    pass
+        if e is not None and e.value[0] == "plasma":
+            try:
+                self.plasma.delete(oid)
+            except Exception:
+                pass
+
+    # ==================================================================
+    # Task submission (reference: normal_task_submitter.cc SubmitTask /
+    # OnWorkerIdle / RequestNewWorkerIfNeeded)
+    # ==================================================================
+    def _serialize_args(self, args: tuple, kwargs: dict) -> Tuple[List[TaskArg], List[TaskArg]]:
+        out_args: List[TaskArg] = []
+        out_kwargs: Dict[str, TaskArg] = {}
+
+        def conv(v) -> TaskArg:
+            if isinstance(v, ObjectRef):
+                self._ref_counter().add_submitted_task_ref(v.id())
+                owner = v.owner_address or self.address
+                return TaskArg(is_ref=True, object_id=v.id(), owner_addr=tuple(owner))
+            data = serialize(v)
+            if len(data) > config.object_store_inline_max_bytes:
+                # promote big arg to an owned shared-memory object
+                w = worker_mod.global_worker
+                oid = ObjectID.from_index(w.current_task_id, w.next_put_index())
+                self.put_serialized(oid, data)
+                self._ref_counter().add_owned_object(oid)
+                self._ref_counter().add_submitted_task_ref(oid)
+                return TaskArg(is_ref=True, object_id=oid, owner_addr=self.address)
+            return TaskArg(is_ref=False, value=data)
+
+        for a in args:
+            out_args.append(conv(a))
+        kw = {k: conv(v) for k, v in kwargs.items()}
+        return out_args, kw
+
+    def submit_task(self, remote_function, args, kwargs, opts: TaskOptions) -> List[ObjectRef]:
+        w = worker_mod.global_worker
+        task_id = TaskID.for_normal_task(self.job_id)
+        ser_args, ser_kwargs = self._serialize_args(args, kwargs)
+        from ray_tpu._private.serialization import dumps_function
+
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id,
+            task_type=TaskType.NORMAL_TASK,
+            function_descriptor=remote_function._descriptor,
+            args=ser_args,
+            num_returns=opts.num_returns,
+            resources=opts.resources,
+            scheduling_strategy=opts.scheduling_strategy,
+            max_retries=opts.max_retries,
+            retry_exceptions=opts.retry_exceptions,
+            caller_addr=self.address,
+            serialized_function=dumps_function(remote_function._function),
+            runtime_env=opts.runtime_env,
+        )
+        spec.kwargs_map = ser_kwargs  # type: ignore[attr-defined]
+        return_ids = spec.return_ids()
+        for oid in return_ids:
+            self._ref_counter().add_owned_object(oid, pending_creation=True)
+        self._pending_tasks[task_id] = {"spec": spec, "retries_left": opts.max_retries}
+        self.loop_thread.call_soon(self._submit_spec_threadsafe, spec)
+        return [ObjectRef(oid, owner_addr=self.address) for oid in return_ids]
+
+    def _submit_spec_threadsafe(self, spec: TaskSpec) -> None:
+        import asyncio
+
+        asyncio.ensure_future(self._submit_spec(spec))
+
+    async def _submit_spec(self, spec: TaskSpec) -> None:
+        """Runs on the io loop: acquire a lease (cached or new) and push."""
+        sc = spec.scheduling_class
+        with self._lock:
+            lease = None
+            for entry in self._leases.get(sc, []):
+                if not entry.busy:
+                    entry.busy = True
+                    lease = entry
+                    break
+        if lease is None:
+            self._task_queue.setdefault(sc, []).append(spec)
+            await self._maybe_request_lease(sc, spec)
+            return
+        await self._push_task(spec, lease)
+
+    async def _maybe_request_lease(self, sc, spec: TaskSpec) -> None:
+        with self._lock:
+            inflight = self._lease_requests_inflight.get(sc, 0)
+            queued = len(self._task_queue.get(sc, []))
+            if inflight >= min(queued, config.max_pending_lease_requests_per_class):
+                return
+            self._lease_requests_inflight[sc] = inflight + 1
+        try:
+            strategy = spec.scheduling_strategy
+            reply = await self.raylet.acall(
+                "RequestWorkerLease",
+                resources=spec.resources,
+                scheduling_class=sc,
+                job_id=self.job_id.hex(),
+                pg_id=strategy.placement_group_id,
+                bundle_index=strategy.placement_group_bundle_index,
+                lease_timeout=config.worker_lease_timeout_ms / 1000.0,
+                timeout=config.worker_lease_timeout_ms / 1000.0 + 10.0,
+            )
+        except Exception as e:  # noqa: BLE001
+            logger.warning("lease request failed: %s", e)
+            reply = {"granted": False, "error": str(e)}
+        finally:
+            with self._lock:
+                self._lease_requests_inflight[sc] = self._lease_requests_inflight.get(sc, 1) - 1
+        if not reply.get("granted"):
+            if reply.get("infeasible"):
+                err = RayTaskError(
+                    spec.function_descriptor.repr_name,
+                    f"Infeasible resource request: {reply.get('error')}",
+                )
+                self._fail_queued_tasks(sc, err)
+            else:
+                # re-kick if tasks remain
+                with self._lock:
+                    remaining = bool(self._task_queue.get(sc))
+                if remaining:
+                    import asyncio
+
+                    await asyncio.sleep(0.1)
+                    await self._maybe_request_lease(sc, spec)
+            return
+        entry = _LeaseEntry(reply["lease_id"], tuple(reply["worker_addr"]))
+        logger.debug("lease %s granted (worker %s)", entry.lease_id[:8], entry.worker_addr)
+        with self._lock:
+            self._leases.setdefault(sc, []).append(entry)
+        await self._on_lease_idle(sc, entry)
+
+    def _fail_queued_tasks(self, sc, err: Exception) -> None:
+        with self._lock:
+            specs = self._task_queue.pop(sc, [])
+        data = serialize(err if isinstance(err, RayTaskError) else RayTaskError("task", str(err)))
+        for s in specs:
+            for oid in s.return_ids():
+                self.memory_store.put(oid, ("inline", data))
+            self._pending_tasks.pop(s.task_id, None)
+
+    async def _on_lease_idle(self, sc, entry: _LeaseEntry) -> None:
+        """Reuse the leased worker for the next queued task, or return it."""
+        with self._lock:
+            queue = self._task_queue.get(sc, [])
+            spec = queue.pop(0) if queue else None
+            if spec is not None:
+                entry.busy = True
+        if spec is None:
+            await self._return_lease(sc, entry)
+            return
+        await self._push_task(spec, entry)
+
+    async def _return_lease(self, sc, entry: _LeaseEntry) -> None:
+        with self._lock:
+            entries = self._leases.get(sc, [])
+            if entry in entries:
+                entries.remove(entry)
+        try:
+            await self.raylet.acall("ReturnWorkerLease", lease_id=entry.lease_id)
+        except Exception as e:  # noqa: BLE001
+            logger.warning("ReturnWorkerLease %s failed: %s", entry.lease_id[:8], e)
+
+    async def _push_task(self, spec: TaskSpec, entry: _LeaseEntry) -> None:
+        client = get_client(entry.worker_addr)
+        try:
+            reply = await client.acall(
+                "PushTask",
+                spec_payload=self._pack_spec(spec),
+                timeout=-1,  # tasks can run arbitrarily long
+            )
+        except RemoteError as e:
+            # worker is alive but the push itself failed (e.g. function
+            # could not be loaded) — a task error, NOT a worker death
+            err = RayTaskError(spec.function_descriptor.repr_name, str(e))
+            data = serialize(err)
+            for oid in spec.return_ids():
+                self.memory_store.put(oid, ("inline", data))
+            self._pending_tasks.pop(spec.task_id, None)
+            entry.busy = False
+            await self._on_lease_idle(spec.scheduling_class, entry)
+            return
+        except Exception as e:  # noqa: BLE001
+            logger.warning("push task %s failed: %s", spec.task_id.hex()[:12], e)
+            await self._handle_worker_failure(spec, entry, e)
+            return
+        self._complete_task(spec, reply)
+        entry.busy = False
+        entry.last_used = time.monotonic()
+        await self._on_lease_idle(spec.scheduling_class, entry)
+
+    def _driver_py_paths(self) -> List[str]:
+        """sys.path entries to replicate on workers so cloudpickle
+        by-reference functions resolve (reference: runtime_env py_modules /
+        working_dir shipping, _private/runtime_env/working_dir.py)."""
+        import os
+        import sys
+
+        cached = getattr(self, "_py_paths_cache", None)
+        if cached is None:
+            cached = [p for p in sys.path if p and os.path.isdir(p)]
+            self._py_paths_cache = cached
+        return cached
+
+    def _pack_spec(self, spec: TaskSpec) -> dict:
+        return {
+            "py_paths": self._driver_py_paths(),
+            "task_id": spec.task_id.binary(),
+            "job_id": spec.job_id.binary(),
+            "task_type": spec.task_type.value,
+            "function_name": spec.function_descriptor.repr_name,
+            "serialized_function": spec.serialized_function,
+            "function_key": spec.function_key,
+            "args": [
+                {
+                    "is_ref": a.is_ref,
+                    "value": a.value,
+                    "object_id": a.object_id.binary() if a.object_id else None,
+                    "owner_addr": a.owner_addr,
+                }
+                for a in spec.args
+            ],
+            "kwargs": {
+                k: {
+                    "is_ref": a.is_ref,
+                    "value": a.value,
+                    "object_id": a.object_id.binary() if a.object_id else None,
+                    "owner_addr": a.owner_addr,
+                }
+                for k, a in getattr(spec, "kwargs_map", {}).items()
+            },
+            "num_returns": spec.num_returns,
+            "caller_addr": spec.caller_addr,
+            "retry_exceptions": spec.retry_exceptions,
+            "attempt_number": spec.attempt_number,
+        }
+
+    async def _handle_worker_failure(self, spec: TaskSpec, entry: _LeaseEntry, error: Exception) -> None:
+        sc = spec.scheduling_class
+        with self._lock:
+            entries = self._leases.get(sc, [])
+            if entry in entries:
+                entries.remove(entry)
+        try:
+            await self.raylet.acall("ReturnWorkerLease", lease_id=entry.lease_id, worker_dead=True)
+        except Exception:
+            pass
+        st = self._pending_tasks.get(spec.task_id)
+        if st is not None and st["retries_left"] > 0:
+            st["retries_left"] -= 1
+            spec.attempt_number += 1
+            logger.info("retrying task %s (%d left)", spec.task_id.hex()[:12], st["retries_left"])
+            await self._submit_spec(spec)
+        else:
+            err = RayTaskError(
+                spec.function_descriptor.repr_name,
+                f"Worker died while running the task: {error}",
+                WorkerCrashedError(str(error)),
+            )
+            data = serialize(err)
+            for oid in spec.return_ids():
+                self.memory_store.put(oid, ("inline", data))
+            self._pending_tasks.pop(spec.task_id, None)
+
+    def _complete_task(self, spec: TaskSpec, reply: dict) -> None:
+        returns = reply.get("returns", [])
+        retriable_error = reply.get("retriable_error")
+        if retriable_error and spec.retry_exceptions:
+            st = self._pending_tasks.get(spec.task_id)
+            if st is not None and st["retries_left"] > 0:
+                st["retries_left"] -= 1
+                spec.attempt_number += 1
+                self.loop_thread.call_soon(self._submit_spec_threadsafe, spec)
+                return
+        for i, ret in enumerate(returns):
+            oid = ObjectID.from_index(spec.task_id, i + 1)
+            if ret["kind"] == "inline":
+                self.memory_store.put(oid, ("inline", ret["data"]))
+            else:
+                self.memory_store.put(oid, ("plasma", ret.get("node_id", self.node_id)))
+        # release submitted-task arg refs
+        for a in spec.args + list(getattr(spec, "kwargs_map", {}).values()):
+            if a.is_ref and a.object_id is not None:
+                self._ref_counter().remove_submitted_task_ref(a.object_id)
+        self._pending_tasks.pop(spec.task_id, None)
+
+    # ==================================================================
+    # Actors (reference: actor_task_submitter.cc; GCS-mediated creation
+    # gcs_actor_manager.cc:314/:433)
+    # ==================================================================
+    def create_actor(self, actor_class, args, kwargs, opts: ActorOptions) -> ActorID:
+        actor_id = ActorID.of(self.job_id)
+        ser_args, ser_kwargs = self._serialize_args(args, kwargs)
+        from ray_tpu._private.serialization import dumps_function
+
+        spec_payload = {
+            "py_paths": self._driver_py_paths(),
+            "serialized_class": dumps_function(actor_class._cls),
+            "class_name": actor_class._name,
+            "args": [
+                {
+                    "is_ref": a.is_ref,
+                    "value": a.value,
+                    "object_id": a.object_id.binary() if a.object_id else None,
+                    "owner_addr": a.owner_addr,
+                }
+                for a in ser_args
+            ],
+            "kwargs": {
+                k: {
+                    "is_ref": a.is_ref,
+                    "value": a.value,
+                    "object_id": a.object_id.binary() if a.object_id else None,
+                    "owner_addr": a.owner_addr,
+                }
+                for k, a in ser_kwargs.items()
+            },
+            "max_concurrency": opts.max_concurrency,
+            "max_restarts": opts.max_restarts,
+        }
+        import pickle
+
+        strategy = opts.scheduling_strategy
+        reply = self.gcs.call_retrying(
+            "RegisterActor",
+            actor_id=actor_id.hex(),
+            job_id=self.job_id.hex(),
+            serialized_spec=pickle.dumps(spec_payload, protocol=5),
+            name=opts.name,
+            namespace=opts.namespace or "default",
+            max_restarts=opts.max_restarts,
+            resources=opts.resources,
+            owner_addr=self.address,
+            detached=(opts.lifetime == "detached"),
+            get_if_exists=opts.get_if_exists,
+            pg_id=strategy.placement_group_id,
+            bundle_index=strategy.placement_group_bundle_index,
+        )
+        if "error" in reply:
+            raise ValueError(reply["error"])
+        return ActorID.from_hex(reply["actor_id"])
+
+    def _resolve_actor(self, actor_id_hex: str, wait_alive_s: float = 60.0) -> Tuple[str, int]:
+        deadline = time.monotonic() + wait_alive_s
+        cached = self._actor_addr_cache.get(actor_id_hex)
+        if cached is not None:
+            return cached[0]
+        version = -1
+        while time.monotonic() < deadline:
+            info = self.gcs.call_retrying("WaitActorUpdate", actor_id=actor_id_hex, from_version=version, timeout_s=5.0, timeout=15)
+            if info is None:
+                raise ActorDiedError(f"Actor {actor_id_hex[:12]} does not exist")
+            version = info["version"]
+            if info["state"] == "ALIVE" and info["worker_addr"]:
+                addr = tuple(info["worker_addr"])
+                self._actor_addr_cache[actor_id_hex] = (addr, version)
+                return addr
+            if info["state"] == "DEAD":
+                raise ActorDiedError(
+                    f"Actor {actor_id_hex[:12]} is dead: {info.get('death_cause', '')}"
+                )
+        raise ActorUnavailableError(f"Actor {actor_id_hex[:12]} not schedulable in time")
+
+    def submit_actor_task(self, handle, method_name, args, kwargs, opts: TaskOptions) -> List[ObjectRef]:
+        actor_id: ActorID = handle._actor_id
+        aid = actor_id.hex()
+        task_id = TaskID.for_actor_task(actor_id)
+        return_ids = [ObjectID.from_index(task_id, i + 1) for i in range(opts.num_returns)]
+        for oid in return_ids:
+            self._ref_counter().add_owned_object(oid, pending_creation=True)
+        ser_args, ser_kwargs = self._serialize_args(args, kwargs)
+        with self._actor_seq_lock:
+            seqno = self._actor_seqno.get(aid, 0)
+            self._actor_seqno[aid] = seqno + 1
+        payload = {
+            "actor_id": aid,
+            "task_id": task_id.binary(),
+            "method_name": method_name,
+            "caller_id": self.worker_id_hex,
+            "seqno": seqno,
+            "num_returns": opts.num_returns,
+            "args": [
+                {
+                    "is_ref": a.is_ref,
+                    "value": a.value,
+                    "object_id": a.object_id.binary() if a.object_id else None,
+                    "owner_addr": a.owner_addr,
+                }
+                for a in ser_args
+            ],
+            "kwargs": {
+                k: {
+                    "is_ref": a.is_ref,
+                    "value": a.value,
+                    "object_id": a.object_id.binary() if a.object_id else None,
+                    "owner_addr": a.owner_addr,
+                }
+                for k, a in ser_kwargs.items()
+            },
+            "caller_addr": self.address,
+        }
+
+        def _bg():
+            try:
+                addr = self._resolve_actor(aid)
+                client = get_client(addr)
+                reply = client.call("PushActorTask", payload=payload, timeout=-1)
+                for i, ret in enumerate(reply.get("returns", [])):
+                    oid = return_ids[i]
+                    if ret["kind"] == "inline":
+                        self.memory_store.put(oid, ("inline", ret["data"]))
+                    else:
+                        self.memory_store.put(oid, ("plasma", ret.get("node_id", self.node_id)))
+            except (RpcConnectionError, ConnectionError, OSError) as e:
+                # actor worker unreachable: report to GCS, mark unavailable
+                try:
+                    cached = self._actor_addr_cache.pop(aid, None)
+                    if cached:
+                        self.gcs.call_retrying(
+                            "ReportActorFault", actor_id=aid, worker_addr=cached[0], error=str(e)
+                        )
+                except Exception:
+                    pass
+                err = serialize(
+                    RayActorError(f"Actor {aid[:12]} became unreachable while executing {method_name}: {e}")
+                )
+                for oid in return_ids:
+                    self.memory_store.put(oid, ("inline", err))
+            except (ActorDiedError, ActorUnavailableError, RayActorError) as e:
+                err = serialize(e)
+                for oid in return_ids:
+                    self.memory_store.put(oid, ("inline", err))
+            except Exception as e:  # noqa: BLE001
+                err = serialize(RayActorError(f"actor call failed: {e!r}"))
+                for oid in return_ids:
+                    self.memory_store.put(oid, ("inline", err))
+
+        threading.Thread(target=_bg, daemon=True).start()
+        return [ObjectRef(oid, owner_addr=self.address) for oid in return_ids]
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
+        self._actor_addr_cache.pop(actor_id.hex(), None)
+        self.gcs.call_retrying("KillActor", actor_id=actor_id.hex(), no_restart=no_restart)
+
+    def get_actor(self, name: str, namespace: Optional[str] = None):
+        aid = self.gcs.call_retrying("GetActorByName", name=name, namespace=namespace or "default")
+        if aid is None:
+            raise ValueError(f"Failed to look up actor with name '{name}'")
+        return ActorID.from_hex(aid)
+
+    def cancel(self, ref: ObjectRef, force: bool = False, recursive: bool = True) -> None:
+        # round-1: best effort — mark so queued (not yet pushed) tasks fail.
+        tid = ref.id().task_id()
+        st = self._pending_tasks.get(tid)
+        if st is not None:
+            err = serialize(TaskCancelledError(f"Task {tid.hex()[:12]} cancelled"))
+            for oid in st["spec"].return_ids():
+                if not self.memory_store.contains(oid):
+                    self.memory_store.put(oid, ("inline", err))
+
+    # ==================================================================
+    # Placement groups
+    # ==================================================================
+    def create_placement_group(self, bundles, strategy, name=""):
+        from ray_tpu._private.ids import PlacementGroupID
+
+        pg_id = PlacementGroupID.from_random()
+        self.gcs.call_retrying(
+            "CreatePlacementGroup",
+            pg_id=pg_id.hex(),
+            name=name,
+            bundles=bundles,
+            strategy=strategy,
+            creator_job=self.job_id.hex(),
+        )
+        return pg_id
+
+    def remove_placement_group(self, pg_id) -> None:
+        self.gcs.call_retrying("RemovePlacementGroup", pg_id=pg_id.hex())
+
+    def placement_group_ready(self, pg_id, timeout=None) -> bool:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            info = self.gcs.call_retrying("GetPlacementGroup", pg_id=pg_id.hex())
+            if info and info["state"] == "CREATED":
+                return True
+            if info and info["state"] in ("REMOVED", "INFEASIBLE"):
+                return False
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(0.05)
+
+    def get_placement_group_info(self, pg_id) -> Optional[dict]:
+        return self.gcs.call_retrying("GetPlacementGroup", pg_id=pg_id.hex())
+
+    # ==================================================================
+    # Cluster info
+    # ==================================================================
+    def cluster_resources(self) -> Dict[str, float]:
+        return self.gcs.call_retrying("GetClusterResources")["total"]
+
+    def available_resources(self) -> Dict[str, float]:
+        return self.gcs.call_retrying("GetClusterResources")["available"]
+
+    def nodes(self) -> List[Dict[str, Any]]:
+        return self.gcs.call_retrying("GetAllNodeInfo")
+
+    def shutdown(self) -> None:
+        if self._shutdown:
+            return
+        self._shutdown = True
+        self.server.stop()
+        try:
+            self.plasma.close()
+        except Exception:
+            pass

@@ -1,0 +1,704 @@
+"""GCS — the head-node control plane process.
+
+Reference: src/ray/gcs/gcs_server.h:99 (GcsServer composes node/actor/job/
+PG/KV managers), gcs_node_manager.cc:102 (register), gcs_actor_manager.cc:314
+(register actor) / :433 (create) / :1721 (SchedulePendingActors),
+gcs_health_check_manager.h:46 (liveness), gcs_kv_manager.h (KV).
+
+One asyncio process: tables in memory, optional file persistence for the KV
+table, periodic health checks that mark silent raylets dead, actor
+scheduling via raylet lease RPCs, and placement-group 2PC (PREPARE/COMMIT
+like node_manager.proto:514-519).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import pickle
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.config import config
+from ray_tpu._private.rpc import RpcClient, RpcServer
+
+logger = logging.getLogger("ray_tpu.gcs")
+
+
+# ---------------------------------------------------------------------------
+# Tables
+# ---------------------------------------------------------------------------
+@dataclass
+class NodeInfo:
+    node_id: str
+    address: Tuple[str, int]  # raylet RPC addr
+    store_socket: str
+    total_resources: Dict[str, float]
+    available_resources: Dict[str, float]
+    alive: bool = True
+    last_heartbeat: float = field(default_factory=time.monotonic)
+    is_head: bool = False
+    labels: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ActorInfo:
+    actor_id: str
+    job_id: str
+    name: Optional[str]
+    namespace: str
+    state: str  # PENDING, ALIVE, RESTARTING, DEAD
+    serialized_spec: bytes  # creation task spec (class + args + opts)
+    owner_addr: Optional[Tuple[str, int]]
+    worker_addr: Optional[Tuple[str, int]] = None
+    node_id: Optional[str] = None
+    worker_id: Optional[str] = None
+    max_restarts: int = 0
+    num_restarts: int = 0
+    resources: Dict[str, float] = field(default_factory=dict)
+    detached: bool = False
+    death_cause: str = ""
+    version: int = 0  # bumped on every state change
+    pg_id: Optional[str] = None
+    bundle_index: int = -1
+
+
+@dataclass
+class PlacementGroupInfo:
+    pg_id: str
+    name: str
+    strategy: str  # PACK, SPREAD, STRICT_PACK, STRICT_SPREAD
+    bundles: List[Dict[str, float]]
+    state: str  # PENDING, CREATED, REMOVED
+    # bundle index -> (node_id, lease)
+    bundle_nodes: Dict[int, str] = field(default_factory=dict)
+    creator_job: str = ""
+
+
+class GcsServer:
+    def __init__(self, port: int, storage_path: str = ""):
+        self.server = RpcServer(port=port, name="gcs")
+        self.storage_path = storage_path
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.actors: Dict[str, ActorInfo] = {}
+        self.named_actors: Dict[Tuple[str, str], str] = {}
+        self.placement_groups: Dict[str, PlacementGroupInfo] = {}
+        self.kv: Dict[str, Dict[str, bytes]] = {}  # namespace -> key -> value
+        self.jobs: Dict[str, Dict[str, Any]] = {}
+        self._job_counter = 0
+        self._raylet_clients: Dict[str, RpcClient] = {}
+        self._actor_events: Dict[str, asyncio.Event] = {}
+        self._node_version = 0
+        self._load_persisted()
+        self.server.register_instance(self)
+
+    # ------------------------------------------------------------------
+    # persistence (KV only, file-backed — GCS restart then replays it;
+    # reference: gcs_table_storage.h over Redis/memory)
+    # ------------------------------------------------------------------
+    def _load_persisted(self) -> None:
+        if self.storage_path and os.path.exists(self.storage_path):
+            try:
+                with open(self.storage_path, "rb") as f:
+                    self.kv = pickle.load(f)
+            except Exception:
+                logger.exception("failed to load persisted KV")
+
+    def _persist(self) -> None:
+        if self.storage_path:
+            tmp = self.storage_path + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump(self.kv, f)
+            os.replace(tmp, self.storage_path)
+
+    def _raylet(self, node_id: str) -> RpcClient:
+        c = self._raylet_clients.get(node_id)
+        if c is None:
+            node = self.nodes[node_id]
+            c = RpcClient(node.address[0], node.address[1])
+            self._raylet_clients[node_id] = c
+        return c
+
+    # ------------------------------------------------------------------
+    # Node management
+    # ------------------------------------------------------------------
+    async def RegisterNode(
+        self,
+        node_id: str,
+        address: Tuple[str, int],
+        store_socket: str,
+        total_resources: Dict[str, float],
+        is_head: bool = False,
+        labels: Optional[Dict[str, str]] = None,
+    ) -> dict:
+        self.nodes[node_id] = NodeInfo(
+            node_id=node_id,
+            address=tuple(address),
+            store_socket=store_socket,
+            total_resources=dict(total_resources),
+            available_resources=dict(total_resources),
+            is_head=is_head,
+            labels=labels or {},
+        )
+        self._node_version += 1
+        logger.info("node %s registered: %s", node_id[:12], total_resources)
+        return {"ok": True}
+
+    async def Heartbeat(
+        self, node_id: str, available_resources: Dict[str, float]
+    ) -> dict:
+        node = self.nodes.get(node_id)
+        if node is None:
+            return {"ok": False, "reregister": True}
+        node.last_heartbeat = time.monotonic()
+        node.available_resources = dict(available_resources)
+        if not node.alive:
+            node.alive = True
+            self._node_version += 1
+        return {"ok": True}
+
+    async def DrainNode(self, node_id: str) -> dict:
+        node = self.nodes.get(node_id)
+        if node:
+            node.alive = False
+            self._node_version += 1
+        return {"ok": True}
+
+    async def GetAllNodeInfo(self) -> List[dict]:
+        return [
+            {
+                "NodeID": n.node_id,
+                "Alive": n.alive,
+                "NodeManagerAddress": n.address[0],
+                "NodeManagerPort": n.address[1],
+                "ObjectStoreSocketName": n.store_socket,
+                "Resources": dict(n.total_resources),
+                "AvailableResources": dict(n.available_resources),
+                "IsHead": n.is_head,
+                "Labels": dict(n.labels),
+            }
+            for n in self.nodes.values()
+        ]
+
+    async def GetClusterResources(self) -> Dict[str, Dict[str, float]]:
+        total: Dict[str, float] = {}
+        avail: Dict[str, float] = {}
+        for n in self.nodes.values():
+            if not n.alive:
+                continue
+            for k, v in n.total_resources.items():
+                total[k] = total.get(k, 0.0) + v
+            for k, v in n.available_resources.items():
+                avail[k] = avail.get(k, 0.0) + v
+        return {"total": total, "available": avail}
+
+    async def _health_check_loop(self) -> None:
+        period = config.gcs_health_check_period_ms / 1000.0
+        threshold = (
+            config.gcs_health_check_period_ms
+            * config.gcs_health_check_failure_threshold
+            / 1000.0
+        )
+        while True:
+            await asyncio.sleep(period)
+            now = time.monotonic()
+            for node in self.nodes.values():
+                if node.alive and now - node.last_heartbeat > threshold:
+                    logger.warning("node %s missed heartbeats; marking dead", node.node_id[:12])
+                    node.alive = False
+                    self._node_version += 1
+                    await self._on_node_death(node.node_id)
+
+    async def _on_node_death(self, node_id: str) -> None:
+        # actors on that node die / restart
+        for actor in list(self.actors.values()):
+            if actor.node_id == node_id and actor.state in ("ALIVE", "PENDING"):
+                await self._handle_actor_failure(actor, f"node {node_id[:12]} died")
+
+    # ------------------------------------------------------------------
+    # Job management
+    # ------------------------------------------------------------------
+    async def RegisterJob(self, driver_addr: Tuple[str, int], metadata: Optional[dict] = None) -> dict:
+        self._job_counter += 1
+        job_id_int = self._job_counter
+        from ray_tpu._private.ids import JobID
+
+        job_id = JobID.from_int(job_id_int).hex()
+        self.jobs[job_id] = {
+            "job_id": job_id,
+            "driver_addr": tuple(driver_addr),
+            "start_time": time.time(),
+            "state": "RUNNING",
+            "metadata": metadata or {},
+        }
+        return {"job_id_int": job_id_int, "job_id": job_id}
+
+    async def MarkJobFinished(self, job_id: str) -> dict:
+        if job_id in self.jobs:
+            self.jobs[job_id]["state"] = "FINISHED"
+            self.jobs[job_id]["end_time"] = time.time()
+        # non-detached actors owned by the job die with it
+        for actor in list(self.actors.values()):
+            if actor.job_id == job_id and not actor.detached and actor.state != "DEAD":
+                await self._kill_actor_impl(actor, "job finished")
+        return {"ok": True}
+
+    async def ListJobs(self) -> List[dict]:
+        return list(self.jobs.values())
+
+    # ------------------------------------------------------------------
+    # KV (function table, runtime env, cluster metadata)
+    # ------------------------------------------------------------------
+    async def KVPut(self, ns: str, key: str, value: bytes, overwrite: bool = True) -> dict:
+        table = self.kv.setdefault(ns, {})
+        if not overwrite and key in table:
+            return {"added": False}
+        table[key] = value
+        self._persist()
+        return {"added": True}
+
+    async def KVGet(self, ns: str, key: str) -> Optional[bytes]:
+        return self.kv.get(ns, {}).get(key)
+
+    async def KVDel(self, ns: str, key: str) -> dict:
+        self.kv.get(ns, {}).pop(key, None)
+        self._persist()
+        return {"ok": True}
+
+    async def KVKeys(self, ns: str, prefix: str = "") -> List[str]:
+        return [k for k in self.kv.get(ns, {}) if k.startswith(prefix)]
+
+    async def KVExists(self, ns: str, key: str) -> bool:
+        return key in self.kv.get(ns, {})
+
+    # ------------------------------------------------------------------
+    # Actor management
+    # ------------------------------------------------------------------
+    async def RegisterActor(
+        self,
+        actor_id: str,
+        job_id: str,
+        serialized_spec: bytes,
+        name: Optional[str],
+        namespace: str,
+        max_restarts: int,
+        resources: Dict[str, float],
+        owner_addr: Tuple[str, int],
+        detached: bool = False,
+        get_if_exists: bool = False,
+        pg_id: Optional[str] = None,
+        bundle_index: int = -1,
+    ) -> dict:
+        if name:
+            existing = self.named_actors.get((namespace, name))
+            if existing is not None:
+                ex = self.actors.get(existing)
+                if ex is not None and ex.state != "DEAD":
+                    if get_if_exists:
+                        return {"actor_id": existing, "existing": True}
+                    return {"error": f"Actor with name '{name}' already exists"}
+        actor = ActorInfo(
+            actor_id=actor_id,
+            job_id=job_id,
+            name=name,
+            namespace=namespace,
+            state="PENDING",
+            serialized_spec=serialized_spec,
+            owner_addr=tuple(owner_addr),
+            max_restarts=max_restarts,
+            resources=dict(resources),
+            detached=detached,
+            pg_id=pg_id,
+            bundle_index=bundle_index,
+        )
+        self.actors[actor_id] = actor
+        if name:
+            self.named_actors[(namespace, name)] = actor_id
+        asyncio.ensure_future(self._schedule_actor(actor))
+        return {"actor_id": actor_id, "existing": False}
+
+    def _pick_node_for(self, resources: Dict[str, float], pg: Optional[PlacementGroupInfo], bundle_index: int) -> Optional[str]:
+        """GCS-side actor scheduling (reference: GcsActorScheduler
+        gcs_actor_scheduler.h:104 — uses cluster resource view)."""
+        if pg is not None:
+            if bundle_index >= 0:
+                return pg.bundle_nodes.get(bundle_index)
+            # any bundle's node with room
+            for idx, nid in pg.bundle_nodes.items():
+                node = self.nodes.get(nid)
+                if node and node.alive:
+                    return nid
+            return None
+        candidates = []
+        for n in self.nodes.values():
+            if not n.alive:
+                continue
+            if all(n.available_resources.get(k, 0.0) >= v for k, v in resources.items()):
+                candidates.append((len(self.actors), n.node_id))
+        if not candidates:
+            # fall back: any node whose *total* resources fit (may queue)
+            for n in self.nodes.values():
+                if n.alive and all(n.total_resources.get(k, 0.0) >= v for k, v in resources.items()):
+                    return n.node_id
+            return None
+        return candidates[0][1]
+
+    async def _schedule_actor(self, actor: ActorInfo) -> None:
+        """Lease a worker for the actor and push its creation task
+        (reference: GcsActorScheduler + SchedulePendingActors
+        gcs_actor_manager.cc:1721)."""
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            if actor.state == "DEAD":
+                return
+            pg = self.placement_groups.get(actor.pg_id) if actor.pg_id else None
+            node_id = self._pick_node_for(actor.resources, pg, actor.bundle_index)
+            if node_id is None:
+                await asyncio.sleep(0.2)
+                continue
+            try:
+                raylet = self._raylet(node_id)
+                reply = await raylet.acall(
+                    "RequestWorkerLease",
+                    resources=actor.resources,
+                    scheduling_class=("actor", actor.actor_id),
+                    job_id=actor.job_id,
+                    for_actor=actor.actor_id,
+                    pg_id=actor.pg_id,
+                    bundle_index=actor.bundle_index,
+                    lease_timeout=50.0,
+                    timeout=60,
+                )
+            except Exception as e:  # noqa: BLE001
+                logger.warning("actor %s lease request to %s failed: %s", actor.actor_id[:12], node_id[:12], e)
+                await asyncio.sleep(0.5)
+                continue
+            if not reply.get("granted"):
+                await asyncio.sleep(0.2)
+                continue
+            worker_addr = tuple(reply["worker_addr"])
+            try:
+                worker = RpcClient(worker_addr[0], worker_addr[1])
+                creation_reply = await worker.acall(
+                    "CreateActor",
+                    actor_id=actor.actor_id,
+                    serialized_spec=actor.serialized_spec,
+                    timeout=config.rpc_call_timeout_s,
+                )
+                worker.close()
+            except Exception as e:  # noqa: BLE001
+                logger.warning("actor %s creation push failed: %s", actor.actor_id[:12], e)
+                await asyncio.sleep(0.5)
+                continue
+            if creation_reply.get("ok"):
+                actor.state = "ALIVE"
+                actor.worker_addr = worker_addr
+                actor.node_id = node_id
+                actor.worker_id = reply.get("worker_id")
+                actor.version += 1
+                self._notify_actor(actor.actor_id)
+                logger.info("actor %s alive on %s", actor.actor_id[:12], node_id[:12])
+                return
+            else:
+                # creation raised in user __init__ — actor is dead
+                actor.state = "DEAD"
+                actor.death_cause = creation_reply.get("error", "creation failed")
+                actor.version += 1
+                self._notify_actor(actor.actor_id)
+                try:
+                    await self._raylet(node_id).acall(
+                        "ReturnWorkerLease", lease_id=reply["lease_id"], worker_dead=False
+                    )
+                except Exception:
+                    pass
+                return
+        actor.state = "DEAD"
+        actor.death_cause = "scheduling timed out (insufficient resources?)"
+        actor.version += 1
+        self._notify_actor(actor.actor_id)
+
+    def _notify_actor(self, actor_id: str) -> None:
+        evt = self._actor_events.get(actor_id)
+        if evt is not None:
+            evt.set()
+            self._actor_events[actor_id] = asyncio.Event()
+
+    async def GetActorInfo(self, actor_id: str) -> Optional[dict]:
+        a = self.actors.get(actor_id)
+        if a is None:
+            return None
+        return {
+            "actor_id": a.actor_id,
+            "state": a.state,
+            "worker_addr": a.worker_addr,
+            "node_id": a.node_id,
+            "name": a.name,
+            "num_restarts": a.num_restarts,
+            "death_cause": a.death_cause,
+            "version": a.version,
+        }
+
+    async def WaitActorUpdate(self, actor_id: str, from_version: int, timeout_s: float = 10.0) -> Optional[dict]:
+        """Long-poll for actor state changes (reference: pubsub actor channel)."""
+        a = self.actors.get(actor_id)
+        if a is None:
+            return None
+        if a.version > from_version:
+            return await self.GetActorInfo(actor_id)
+        evt = self._actor_events.setdefault(actor_id, asyncio.Event())
+        try:
+            await asyncio.wait_for(evt.wait(), timeout=timeout_s)
+        except asyncio.TimeoutError:
+            pass
+        return await self.GetActorInfo(actor_id)
+
+    async def GetActorByName(self, name: str, namespace: str) -> Optional[str]:
+        aid = self.named_actors.get((namespace, name))
+        if aid is not None:
+            a = self.actors.get(aid)
+            if a is not None and a.state != "DEAD":
+                return aid
+        return None
+
+    async def ListActors(self) -> List[dict]:
+        return [await self.GetActorInfo(aid) for aid in list(self.actors)]
+
+    async def ReportActorFault(self, actor_id: str, worker_addr: Tuple[str, int], error: str) -> dict:
+        """Called by a caller that failed to reach the actor's worker."""
+        actor = self.actors.get(actor_id)
+        if actor is None:
+            return {"ok": False}
+        if actor.state == "ALIVE" and actor.worker_addr == tuple(worker_addr):
+            await self._handle_actor_failure(actor, error)
+        return {"ok": True}
+
+    async def NotifyWorkerDeath(self, node_id: str, worker_id: str, worker_addr: Tuple[str, int]) -> dict:
+        """Raylet reports a worker process exit."""
+        for actor in list(self.actors.values()):
+            if actor.state == "ALIVE" and actor.worker_addr == tuple(worker_addr):
+                await self._handle_actor_failure(actor, f"worker process died on {node_id[:12]}")
+        return {"ok": True}
+
+    async def _handle_actor_failure(self, actor: ActorInfo, cause: str) -> None:
+        if actor.num_restarts < actor.max_restarts or actor.max_restarts == -1:
+            actor.num_restarts += 1
+            actor.state = "RESTARTING"
+            actor.worker_addr = None
+            actor.version += 1
+            self._notify_actor(actor.actor_id)
+            logger.info("actor %s restarting (%d/%s): %s", actor.actor_id[:12], actor.num_restarts, actor.max_restarts, cause)
+            asyncio.ensure_future(self._schedule_actor(actor))
+        else:
+            actor.state = "DEAD"
+            actor.death_cause = cause
+            actor.worker_addr = None
+            actor.version += 1
+            self._notify_actor(actor.actor_id)
+
+    async def KillActor(self, actor_id: str, no_restart: bool = True) -> dict:
+        actor = self.actors.get(actor_id)
+        if actor is None:
+            return {"ok": False}
+        await self._kill_actor_impl(actor, "ray_tpu.kill()", no_restart=no_restart)
+        return {"ok": True}
+
+    async def _kill_actor_impl(self, actor: ActorInfo, cause: str, no_restart: bool = True) -> None:
+        worker_addr = actor.worker_addr
+        if no_restart:
+            actor.state = "DEAD"
+            actor.death_cause = cause
+            actor.version += 1
+            if actor.name:
+                self.named_actors.pop((actor.namespace, actor.name), None)
+            self._notify_actor(actor.actor_id)
+        if worker_addr:
+            try:
+                worker = RpcClient(worker_addr[0], worker_addr[1])
+                await worker.acall("KillActor", actor_id=actor.actor_id, timeout=5)
+                worker.close()
+            except Exception:
+                pass
+        if not no_restart:
+            a = self.actors.get(actor.actor_id)
+            if a:
+                await self._handle_actor_failure(a, cause)
+
+    # ------------------------------------------------------------------
+    # Placement groups (2PC prepare/commit — node_manager.proto:514-519)
+    # ------------------------------------------------------------------
+    async def CreatePlacementGroup(
+        self,
+        pg_id: str,
+        name: str,
+        bundles: List[Dict[str, float]],
+        strategy: str,
+        creator_job: str = "",
+    ) -> dict:
+        pg = PlacementGroupInfo(
+            pg_id=pg_id,
+            name=name,
+            strategy=strategy,
+            bundles=[dict(b) for b in bundles],
+            state="PENDING",
+            creator_job=creator_job,
+        )
+        self.placement_groups[pg_id] = pg
+        asyncio.ensure_future(self._schedule_pg(pg))
+        return {"pg_id": pg_id}
+
+    def _plan_bundles(self, pg: PlacementGroupInfo) -> Optional[Dict[int, str]]:
+        """Bin-pack bundles onto alive nodes per strategy (reference:
+        bundle_scheduling_policy.h bundle pack/spread)."""
+        alive = [n for n in self.nodes.values() if n.alive]
+        if not alive:
+            return None
+        # simulate available resources
+        sim = {n.node_id: dict(n.available_resources) for n in alive}
+
+        def fits(nid: str, b: Dict[str, float]) -> bool:
+            return all(sim[nid].get(k, 0.0) >= v for k, v in b.items())
+
+        def take(nid: str, b: Dict[str, float]) -> None:
+            for k, v in b.items():
+                sim[nid][k] = sim[nid].get(k, 0.0) - v
+
+        plan: Dict[int, str] = {}
+        order = list(range(len(pg.bundles)))
+        if pg.strategy in ("PACK", "STRICT_PACK"):
+            node_ids = [n.node_id for n in alive]
+            # try to fit all on one node first
+            for nid in node_ids:
+                if all(fits(nid, b) or take(nid, b) for b in []):
+                    pass
+            for idx in order:
+                b = pg.bundles[idx]
+                placed = False
+                # prefer nodes already used
+                used = list(dict.fromkeys(plan.values()))
+                for nid in used + [n for n in node_ids if n not in used]:
+                    if fits(nid, b):
+                        take(nid, b)
+                        plan[idx] = nid
+                        placed = True
+                        break
+                if not placed:
+                    return None
+            if pg.strategy == "STRICT_PACK" and len(set(plan.values())) > 1:
+                return None
+        else:  # SPREAD / STRICT_SPREAD
+            node_ids = [n.node_id for n in alive]
+            i = 0
+            for idx in order:
+                b = pg.bundles[idx]
+                placed = False
+                for attempt in range(len(node_ids)):
+                    nid = node_ids[(i + attempt) % len(node_ids)]
+                    if pg.strategy == "STRICT_SPREAD" and nid in plan.values():
+                        continue
+                    if fits(nid, b):
+                        take(nid, b)
+                        plan[idx] = nid
+                        i += 1
+                        placed = True
+                        break
+                if not placed:
+                    return None
+        return plan
+
+    async def _schedule_pg(self, pg: PlacementGroupInfo) -> None:
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline and pg.state == "PENDING":
+            plan = self._plan_bundles(pg)
+            if plan is None:
+                await asyncio.sleep(0.2)
+                continue
+            # 2PC: PREPARE on all nodes, then COMMIT (reference:
+            # PrepareBundleResources / CommitBundleResources)
+            prepared: List[Tuple[str, int]] = []
+            ok = True
+            for idx, nid in plan.items():
+                try:
+                    r = await self._raylet(nid).acall(
+                        "PrepareBundle",
+                        pg_id=pg.pg_id,
+                        bundle_index=idx,
+                        resources=pg.bundles[idx],
+                    )
+                    if not r.get("ok"):
+                        ok = False
+                        break
+                    prepared.append((nid, idx))
+                except Exception:
+                    ok = False
+                    break
+            if not ok:
+                for nid, idx in prepared:
+                    try:
+                        await self._raylet(nid).acall("CancelBundle", pg_id=pg.pg_id, bundle_index=idx)
+                    except Exception:
+                        pass
+                await asyncio.sleep(0.2)
+                continue
+            for idx, nid in plan.items():
+                await self._raylet(nid).acall("CommitBundle", pg_id=pg.pg_id, bundle_index=idx)
+            pg.bundle_nodes = plan
+            pg.state = "CREATED"
+            logger.info("placement group %s created: %s", pg.pg_id[:12], {i: n[:8] for i, n in plan.items()})
+            return
+        if pg.state == "PENDING":
+            pg.state = "INFEASIBLE"
+
+    async def GetPlacementGroup(self, pg_id: str) -> Optional[dict]:
+        pg = self.placement_groups.get(pg_id)
+        if pg is None:
+            return None
+        return {
+            "pg_id": pg.pg_id,
+            "name": pg.name,
+            "state": pg.state,
+            "strategy": pg.strategy,
+            "bundles": pg.bundles,
+            "bundle_nodes": dict(pg.bundle_nodes),
+        }
+
+    async def RemovePlacementGroup(self, pg_id: str) -> dict:
+        pg = self.placement_groups.get(pg_id)
+        if pg is None:
+            return {"ok": False}
+        for idx, nid in pg.bundle_nodes.items():
+            try:
+                await self._raylet(nid).acall("ReleaseBundle", pg_id=pg_id, bundle_index=idx)
+            except Exception:
+                pass
+        pg.state = "REMOVED"
+        pg.bundle_nodes = {}
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    async def Ping(self) -> str:
+        return "pong"
+
+    async def run(self) -> None:
+        asyncio.ensure_future(self._health_check_loop())
+        await self.server.serve_forever()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, required=True)
+    parser.add_argument("--storage-path", default="")
+    parser.add_argument("--log-level", default="INFO")
+    args = parser.parse_args()
+    logging.basicConfig(level=args.log_level, format="[gcs] %(levelname)s %(message)s")
+    server = GcsServer(args.port, args.storage_path)
+    try:
+        asyncio.run(server.run())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,195 @@
+"""Node bootstrap — starts/stops the head node's processes.
+
+Reference: python/ray/_private/node.py (Node.start_head_processes :1364 —
+spawns gcs_server; start_ray_processes :1393 — spawns raylet which hosts
+plasma) and services.py process management.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import uuid
+from typing import Dict, Optional, Tuple
+
+import psutil
+
+from ray_tpu._private.config import config
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.rpc import RpcClient
+
+logger = logging.getLogger(__name__)
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def default_node_resources(
+    num_cpus: Optional[float] = None,
+    num_tpus: Optional[float] = None,
+    resources: Optional[Dict[str, float]] = None,
+) -> Dict[str, float]:
+    from ray_tpu.accelerators import tpu as tpu_accel
+
+    out: Dict[str, float] = dict(resources or {})
+    out["CPU"] = float(num_cpus) if num_cpus is not None else float(os.cpu_count() or 1)
+    if num_tpus is not None:
+        out["TPU"] = float(num_tpus)
+    else:
+        n = tpu_accel.TPUAcceleratorManager.get_current_node_num_accelerators()
+        if n:
+            out["TPU"] = float(n)
+    out.setdefault("memory", float(psutil.virtual_memory().available // 2))
+    out.update(tpu_accel.TPUAcceleratorManager.get_current_node_additional_resources())
+    node_ip = "127.0.0.1"
+    out[f"node:{node_ip}"] = 1.0
+    return out
+
+
+class Node:
+    """Manages head-node child processes: GCS, raylet (which owns the
+    object-store daemon and workers)."""
+
+    def __init__(
+        self,
+        num_cpus: Optional[float] = None,
+        num_tpus: Optional[float] = None,
+        resources: Optional[Dict[str, float]] = None,
+        object_store_memory: Optional[int] = None,
+    ):
+        self.session_dir = tempfile.mkdtemp(prefix="ray_tpu_session_")
+        self.node_id = NodeID.from_random().hex()
+        self.gcs_port = config.gcs_port or _free_port()
+        self.gcs_addr: Tuple[str, int] = ("127.0.0.1", self.gcs_port)
+        self.store_socket = os.path.join(self.session_dir, "store.sock")
+        self.store_capacity = int(object_store_memory or config.object_store_memory_bytes)
+        self.resources = default_node_resources(num_cpus, num_tpus, resources)
+        self.gcs_proc: Optional[subprocess.Popen] = None
+        self.raylet_proc: Optional[subprocess.Popen] = None
+        self.raylet_port: Optional[int] = None
+
+    @property
+    def raylet_addr(self) -> Tuple[str, int]:
+        return ("127.0.0.1", self.raylet_port)
+
+    def start(self) -> None:
+        env = dict(os.environ)
+        env["RAY_TPU_CONFIG_JSON"] = config.to_json()
+        pythonpath = os.pathsep.join(
+            p for p in [os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), env.get("PYTHONPATH", "")] if p
+        )
+        env["PYTHONPATH"] = pythonpath
+        gcs_log = open(os.path.join(self.session_dir, "gcs.log"), "ab")
+        self.gcs_proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "ray_tpu._private.gcs.server",
+                "--port",
+                str(self.gcs_port),
+                "--storage-path",
+                config.gcs_storage_path,
+            ],
+            env=env,
+            stdout=gcs_log,
+            stderr=subprocess.STDOUT,
+        )
+        self._wait_rpc_ready(self.gcs_addr, "GCS")
+
+        port_file = os.path.join(self.session_dir, "raylet_port")
+        raylet_log = open(os.path.join(self.session_dir, "raylet.log"), "ab")
+        self.raylet_proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "ray_tpu._private.raylet.raylet",
+                "--node-id",
+                self.node_id,
+                "--gcs-addr",
+                f"{self.gcs_addr[0]}:{self.gcs_addr[1]}",
+                "--resources-json",
+                json.dumps(self.resources),
+                "--store-socket",
+                self.store_socket,
+                "--store-capacity",
+                str(self.store_capacity),
+                "--is-head",
+                "--session-dir",
+                self.session_dir,
+                "--port-file",
+                port_file,
+                "--log-level",
+                os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
+            ],
+            env=env,
+            stdout=raylet_log,
+            stderr=subprocess.STDOUT,
+        )
+        deadline = time.monotonic() + 30
+        while not os.path.exists(port_file):
+            if self.raylet_proc.poll() is not None:
+                raise RuntimeError(
+                    f"raylet exited with {self.raylet_proc.returncode}; "
+                    f"see {self.session_dir}/raylet.log"
+                )
+            if time.monotonic() > deadline:
+                raise RuntimeError("raylet failed to start in time")
+            time.sleep(0.02)
+        with open(port_file) as f:
+            self.raylet_port = int(f.read().strip())
+        atexit.register(self.stop)
+
+    def _wait_rpc_ready(self, addr: Tuple[str, int], name: str, timeout: float = 30.0) -> None:
+        client = RpcClient(addr[0], addr[1])
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                client.call("Ping", timeout=2)
+                return
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(f"{name} did not become ready at {addr}")
+                time.sleep(0.05)
+
+    def stop(self) -> None:
+        for proc in (self.raylet_proc, self.gcs_proc):
+            if proc is None or proc.poll() is not None:
+                continue
+            try:
+                # kill the whole tree (raylet owns store + workers)
+                parent = psutil.Process(proc.pid)
+                children = parent.children(recursive=True)
+                proc.terminate()
+                try:
+                    proc.wait(timeout=3)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                for c in children:
+                    try:
+                        c.terminate()
+                    except psutil.Error:
+                        pass
+                _, alive = psutil.wait_procs(children, timeout=2)
+                for c in alive:
+                    try:
+                        c.kill()
+                    except psutil.Error:
+                        pass
+            except (psutil.Error, OSError):
+                pass
+        self.raylet_proc = None
+        self.gcs_proc = None

@@ -1,0 +1,627 @@
+"""Raylet — the per-node daemon: worker pool + local scheduler + leases.
+
+Reference: src/ray/raylet/ — NodeManager (node_manager.h:144, lease RPCs
+node_manager.cc:1834/2136), WorkerPool (worker_pool.h:280 PopWorker/
+PrestartWorkers), scheduling (cluster_lease_manager.cc:45 queue, :194
+schedule-and-grant), PlacementGroupResourceManager (2PC bundle reserve).
+
+TPU-first: the resource set tracks individual TPU chip ids; a lease that
+asks for ``TPU: n`` is granted concrete chips and its worker gets
+``TPU_VISIBLE_CHIPS`` set, generalizing the reference's accelerator-id
+assignment (worker.py:876 set_visible_accelerator_ids) to TPU natively.
+
+The raylet also supervises the node's object-store daemon and its worker
+processes (it is their parent, like the reference's raylet forking language
+workers via WorkerPool::StartWorkerProcess).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private.config import config
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.rpc import RpcClient, RpcServer
+
+logger = logging.getLogger("ray_tpu.raylet")
+
+
+# ---------------------------------------------------------------------------
+# Resource accounting (reference: src/ray/common/scheduling/
+# cluster_resource_data.h ResourceSet/ResourceInstanceSet — TPU chips are
+# tracked as instances so leases get concrete chip ids)
+# ---------------------------------------------------------------------------
+class ResourceSet:
+    def __init__(self, total: Dict[str, float]):
+        self.total = dict(total)
+        self.available = dict(total)
+        n_tpu = int(total.get("TPU", 0))
+        self.free_tpu_chips: List[int] = list(range(n_tpu))
+
+    def can_fit(self, req: Dict[str, float]) -> bool:
+        return all(self.available.get(k, 0.0) + 1e-9 >= v for k, v in req.items())
+
+    def feasible(self, req: Dict[str, float]) -> bool:
+        return all(self.total.get(k, 0.0) + 1e-9 >= v for k, v in req.items())
+
+    def allocate(self, req: Dict[str, float]) -> Optional[Dict[str, Any]]:
+        if not self.can_fit(req):
+            return None
+        for k, v in req.items():
+            self.available[k] = self.available.get(k, 0.0) - v
+        chips: List[int] = []
+        n = int(req.get("TPU", 0))
+        if n > 0:
+            chips = self.free_tpu_chips[:n]
+            self.free_tpu_chips = self.free_tpu_chips[n:]
+        return {"resources": dict(req), "tpu_chips": chips}
+
+    def release(self, alloc: Dict[str, Any]) -> None:
+        for k, v in alloc.get("resources", {}).items():
+            self.available[k] = min(self.total.get(k, 0.0), self.available.get(k, 0.0) + v)
+        chips = alloc.get("tpu_chips", [])
+        if chips:
+            self.free_tpu_chips.extend(chips)
+            self.free_tpu_chips.sort()
+
+
+@dataclass
+class WorkerHandle:
+    worker_id: str
+    proc: subprocess.Popen
+    addr: Optional[Tuple[str, int]] = None
+    registered: asyncio.Event = field(default_factory=asyncio.Event)
+    busy_lease: Optional[str] = None
+    idle_since: float = field(default_factory=time.monotonic)
+    dead: bool = False
+
+
+@dataclass
+class Lease:
+    lease_id: str
+    worker: WorkerHandle
+    alloc: Dict[str, Any]
+    scheduling_class: Any
+    job_id: str
+    for_actor: Optional[str] = None
+    blocked: bool = False  # worker is blocked in get(); CPU released
+
+
+@dataclass
+class PendingLease:
+    request: dict
+    future: asyncio.Future
+
+
+class Raylet:
+    def __init__(
+        self,
+        node_id: str,
+        gcs_addr: Tuple[str, int],
+        resources: Dict[str, float],
+        store_socket: str,
+        store_capacity: int,
+        port: int = 0,
+        is_head: bool = False,
+        labels: Optional[Dict[str, str]] = None,
+        session_dir: str = "",
+    ):
+        self.node_id = node_id
+        self.gcs_addr = gcs_addr
+        self.resources = ResourceSet(resources)
+        self.store_socket = store_socket
+        self.store_capacity = store_capacity
+        self.is_head = is_head
+        self.labels = labels or {}
+        self.session_dir = session_dir or tempfile.mkdtemp(prefix="ray_tpu_")
+        self.server = RpcServer(port=port, name="raylet")
+        self.server.register_instance(self)
+        self.gcs: Optional[RpcClient] = None
+        self.store_proc: Optional[subprocess.Popen] = None
+        self.workers: Dict[str, WorkerHandle] = {}
+        self.idle_workers: List[WorkerHandle] = []
+        self.leases: Dict[str, Lease] = {}
+        self.pending: List[PendingLease] = []
+        # placement group bundles: (pg_id, bundle_index) -> alloc
+        self.prepared_bundles: Dict[Tuple[str, int], Dict[str, Any]] = {}
+        self.committed_bundles: Dict[Tuple[str, int], "ResourceSet"] = {}
+        self._starting_workers = 0
+
+    # ------------------------------------------------------------------
+    # Worker pool (reference: worker_pool.h:280)
+    # ------------------------------------------------------------------
+    def _spawn_worker(self) -> WorkerHandle:
+        worker_id = uuid.uuid4().hex
+        env = dict(os.environ)
+        env["RAY_TPU_WORKER_ID"] = worker_id
+        env["RAY_TPU_RAYLET_ADDR"] = f"{self.server.host}:{self.server.port}"
+        env["RAY_TPU_GCS_ADDR"] = f"{self.gcs_addr[0]}:{self.gcs_addr[1]}"
+        env["RAY_TPU_STORE_SOCKET"] = self.store_socket
+        env["RAY_TPU_NODE_ID"] = self.node_id
+        env["RAY_TPU_CONFIG_JSON"] = config.to_json()
+        # workers must not grab the TPU runtime at import; chips are
+        # assigned per-lease via TPU_VISIBLE_CHIPS
+        env.setdefault("JAX_PLATFORMS", "")
+        log_path = os.path.join(self.session_dir, f"worker-{worker_id[:8]}.log")
+        logf = open(log_path, "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.workers.default_worker"],
+            env=env,
+            stdout=logf,
+            stderr=subprocess.STDOUT,
+        )
+        handle = WorkerHandle(worker_id=worker_id, proc=proc)
+        self.workers[worker_id] = handle
+        return handle
+
+    async def RegisterWorker(self, worker_id: str, addr: Tuple[str, int]) -> dict:
+        handle = self.workers.get(worker_id)
+        if handle is None:
+            return {"ok": False}
+        handle.addr = tuple(addr)
+        handle.registered.set()
+        logger.info("worker %s registered at %s", worker_id[:8], addr)
+        return {"ok": True, "node_id": self.node_id}
+
+    async def _get_idle_worker(self) -> Optional[WorkerHandle]:
+        while self.idle_workers:
+            w = self.idle_workers.pop()
+            if not w.dead and w.proc.poll() is None:
+                return w
+        if len(self.workers) + self._starting_workers >= config.max_workers_per_node:
+            return None
+        self._starting_workers += 1
+        try:
+            handle = self._spawn_worker()
+            logger.debug("spawning worker %s (pid %s)", handle.worker_id[:8], handle.proc.pid)
+            try:
+                await asyncio.wait_for(
+                    handle.registered.wait(), timeout=config.worker_startup_timeout_s
+                )
+            except asyncio.TimeoutError:
+                logger.error(
+                    "worker %s failed to register in time (proc poll=%s)",
+                    handle.worker_id[:8],
+                    handle.proc.poll(),
+                )
+                handle.dead = True
+                handle.proc.kill()
+                self.workers.pop(handle.worker_id, None)
+                return None
+            return handle
+        finally:
+            self._starting_workers -= 1
+
+    # ------------------------------------------------------------------
+    # Lease protocol (reference: node_manager.cc:1834 HandleRequestWorkerLease,
+    # cluster_lease_manager.cc queue/grant)
+    # ------------------------------------------------------------------
+    async def RequestWorkerLease(
+        self,
+        resources: Dict[str, float],
+        scheduling_class: Any,
+        job_id: str,
+        for_actor: Optional[str] = None,
+        pg_id: Optional[str] = None,
+        bundle_index: int = -1,
+        lease_timeout: float = 25.0,
+    ) -> dict:
+        req = {
+            "resources": dict(resources),
+            "scheduling_class": scheduling_class,
+            "job_id": job_id,
+            "for_actor": for_actor,
+            "pg_id": pg_id,
+            "bundle_index": bundle_index,
+        }
+        logger.debug(
+            "lease request %s avail=%s idle=%d workers=%d",
+            resources,
+            self.resources.available,
+            len(self.idle_workers),
+            len(self.workers),
+        )
+        grant = await self._try_grant(req)
+        if grant is not None:
+            return grant
+        rs = self._resource_set_for(req)
+        if not rs.feasible(self._cpu_only(req["resources"], pg_id)):
+            return {
+                "granted": False,
+                "infeasible": True,
+                "error": f"resources {resources} can never be satisfied on this node "
+                f"(total: {rs.total})",
+            }
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        pl = PendingLease(req, fut)
+        self.pending.append(pl)
+        try:
+            return await asyncio.wait_for(fut, timeout=lease_timeout)
+        except asyncio.TimeoutError:
+            try:
+                self.pending.remove(pl)
+            except ValueError:
+                pass
+            return {"granted": False, "infeasible": False, "error": "lease wait timed out"}
+
+    def _cpu_only(self, resources: Dict[str, float], pg_id: Optional[str]) -> Dict[str, float]:
+        return dict(resources)
+
+    def _resource_set_for(self, req: dict) -> ResourceSet:
+        pg_id = req.get("pg_id")
+        if pg_id:
+            key = (pg_id, req.get("bundle_index", -1))
+            if key in self.committed_bundles:
+                return self.committed_bundles[key]
+            # bundle_index -1: any committed bundle of that pg with room
+            for (p, idx), rs in self.committed_bundles.items():
+                if p == pg_id and rs.can_fit(req["resources"]):
+                    return rs
+            for (p, idx), rs in self.committed_bundles.items():
+                if p == pg_id:
+                    return rs
+        return self.resources
+
+    async def _try_grant(self, req: dict) -> Optional[dict]:
+        rs = self._resource_set_for(req)
+        # allocate BEFORE any await: resource accounting is what bounds
+        # concurrent lease grants (and worker spawns) on this node
+        alloc = rs.allocate(req["resources"])
+        if alloc is None:
+            return None
+        worker = await self._get_idle_worker()
+        if worker is None:
+            rs.release(alloc)
+            return None
+        alloc["from_pg"] = (req.get("pg_id"), req.get("bundle_index")) if req.get("pg_id") else None
+        lease_id = uuid.uuid4().hex
+        lease = Lease(
+            lease_id=lease_id,
+            worker=worker,
+            alloc=alloc,
+            scheduling_class=req["scheduling_class"],
+            job_id=req["job_id"],
+            for_actor=req.get("for_actor"),
+        )
+        worker.busy_lease = lease_id
+        self.leases[lease_id] = lease
+        logger.debug("granting lease %s to worker %s (avail now %s)", lease_id[:8], worker.worker_id[:8], rs.available)
+        # configure the leased worker's visible TPU chips
+        try:
+            wclient = RpcClient(worker.addr[0], worker.addr[1])
+            await wclient.acall(
+                "SetLeaseContext",
+                lease_id=lease_id,
+                tpu_chips=alloc["tpu_chips"],
+                resources=alloc["resources"],
+                timeout=10,
+            )
+            wclient.close()
+        except Exception as e:  # noqa: BLE001
+            logger.warning("failed to set lease context on worker: %s", e)
+            self._release_lease(lease, worker_dead=True)
+            return None
+        return {
+            "granted": True,
+            "lease_id": lease_id,
+            "worker_addr": worker.addr,
+            "worker_id": worker.worker_id,
+            "tpu_chips": alloc["tpu_chips"],
+        }
+
+    def _release_lease(self, lease: Lease, worker_dead: bool) -> None:
+        rs = self._rs_for_lease(lease)
+        alloc = lease.alloc
+        if lease.blocked:
+            # the CPU share was already released when the worker blocked
+            res = dict(alloc["resources"])
+            res.pop("CPU", None)
+            alloc = dict(alloc, resources=res)
+        rs.release(alloc)
+        self.leases.pop(lease.lease_id, None)
+        w = lease.worker
+        w.busy_lease = None
+        if worker_dead or w.proc.poll() is not None:
+            w.dead = True
+            self.workers.pop(w.worker_id, None)
+            try:
+                w.proc.kill()
+            except Exception:
+                pass
+        else:
+            w.idle_since = time.monotonic()
+            self.idle_workers.append(w)
+
+    async def NotifyWorkerBlocked(self, lease_id: str) -> dict:
+        """Worker is blocked in get() waiting on objects: temporarily release
+        its CPU so dependents can run (reference: NodeManager::
+        HandleNotifyDirectCallTaskBlocked, src/ray/raylet/node_manager.cc —
+        prevents nested-task deadlock). TPU chips stay assigned."""
+        lease = self.leases.get(lease_id)
+        if lease is not None and not lease.blocked:
+            lease.blocked = True
+            cpu = lease.alloc["resources"].get("CPU", 0.0)
+            if cpu:
+                rs = self._rs_for_lease(lease)
+                rs.available["CPU"] = rs.available.get("CPU", 0.0) + cpu
+            await self._drain_pending()
+        return {"ok": True}
+
+    async def NotifyWorkerUnblocked(self, lease_id: str) -> dict:
+        lease = self.leases.get(lease_id)
+        if lease is not None and lease.blocked:
+            lease.blocked = False
+            cpu = lease.alloc["resources"].get("CPU", 0.0)
+            if cpu:
+                # may go negative: transient oversubscription, like the
+                # reference's cpu-borrowing on unblock
+                rs = self._rs_for_lease(lease)
+                rs.available["CPU"] = rs.available.get("CPU", 0.0) - cpu
+        return {"ok": True}
+
+    def _rs_for_lease(self, lease: Lease) -> ResourceSet:
+        if lease.alloc.get("from_pg"):
+            return self.committed_bundles.get(tuple(lease.alloc["from_pg"]), self.resources)
+        return self.resources
+
+    async def ReturnWorkerLease(self, lease_id: str, worker_dead: bool = False) -> dict:
+        lease = self.leases.get(lease_id)
+        logger.debug("return lease %s (found=%s, dead=%s)", lease_id[:8], lease is not None, worker_dead)
+        if lease is None:
+            return {"ok": False}
+        self._release_lease(lease, worker_dead)
+        await self._drain_pending()
+        return {"ok": True}
+
+    def _undo_grant(self, grant: dict) -> None:
+        """Roll back a grant whose requester vanished (timed-out future)."""
+        lease = self.leases.get(grant["lease_id"])
+        if lease is not None:
+            self._release_lease(lease, worker_dead=False)
+
+    async def _drain_pending(self) -> None:
+        still: List[PendingLease] = []
+        for p in self.pending:
+            if p.future.done():
+                continue
+            grant = await self._try_grant(p.request)
+            if grant is None:
+                still.append(p)
+                continue
+            # the future may have been cancelled (requester timeout) while
+            # _try_grant awaited worker startup — undo, don't leak the lease
+            if p.future.done():
+                self._undo_grant(grant)
+                continue
+            try:
+                p.future.set_result(grant)
+            except asyncio.InvalidStateError:
+                self._undo_grant(grant)
+        self.pending = [p for p in still if not p.future.done()]
+
+    # ------------------------------------------------------------------
+    # Placement group bundles (reference: placement_group_resource_manager.h
+    # 2PC prepare/commit/cancel/release)
+    # ------------------------------------------------------------------
+    async def PrepareBundle(self, pg_id: str, bundle_index: int, resources: Dict[str, float]) -> dict:
+        alloc = self.resources.allocate(resources)
+        if alloc is None:
+            return {"ok": False, "error": "insufficient resources"}
+        self.prepared_bundles[(pg_id, bundle_index)] = alloc
+        return {"ok": True}
+
+    async def CommitBundle(self, pg_id: str, bundle_index: int) -> dict:
+        alloc = self.prepared_bundles.pop((pg_id, bundle_index), None)
+        if alloc is None:
+            return {"ok": False}
+        total = dict(alloc["resources"])
+        rs = ResourceSet(total)
+        # bundle inherits concrete chips reserved from the node
+        rs.free_tpu_chips = list(alloc.get("tpu_chips", []))
+        rs._node_alloc = alloc  # keep to release back later
+        self.committed_bundles[(pg_id, bundle_index)] = rs
+        return {"ok": True}
+
+    async def CancelBundle(self, pg_id: str, bundle_index: int) -> dict:
+        alloc = self.prepared_bundles.pop((pg_id, bundle_index), None)
+        if alloc is not None:
+            self.resources.release(alloc)
+        return {"ok": True}
+
+    async def ReleaseBundle(self, pg_id: str, bundle_index: int) -> dict:
+        rs = self.committed_bundles.pop((pg_id, bundle_index), None)
+        if rs is not None and hasattr(rs, "_node_alloc"):
+            self.resources.release(rs._node_alloc)
+        await self._drain_pending()
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    async def GetState(self) -> dict:
+        return {
+            "node_id": self.node_id,
+            "total": self.resources.total,
+            "available": self.resources.available,
+            "num_workers": len(self.workers),
+            "num_idle": len(self.idle_workers),
+            "num_leases": len(self.leases),
+            "pending_leases": len(self.pending),
+            "bundles": list(self.committed_bundles.keys()),
+        }
+
+    async def Ping(self) -> str:
+        return "pong"
+
+    # ------------------------------------------------------------------
+    async def _heartbeat_loop(self) -> None:
+        period = config.raylet_heartbeat_period_ms / 1000.0
+        while True:
+            try:
+                reply = await self.gcs.acall(
+                    "Heartbeat",
+                    node_id=self.node_id,
+                    available_resources=self.resources.available,
+                    timeout=10,
+                )
+                if reply.get("reregister"):
+                    await self._register()
+            except Exception as e:  # noqa: BLE001
+                logger.warning("heartbeat failed: %s", e)
+            await asyncio.sleep(period)
+
+    async def _reap_loop(self) -> None:
+        """Detect dead worker processes; free leases; tell GCS (for actor
+        fail-over) — reference: raylet owns worker procs and reports deaths."""
+        while True:
+            await asyncio.sleep(0.5)
+            for w in list(self.workers.values()):
+                if w.proc.poll() is not None and not w.dead:
+                    logger.warning("worker %s exited with %s", w.worker_id[:8], w.proc.returncode)
+                    lease = self.leases.get(w.busy_lease) if w.busy_lease else None
+                    addr = w.addr
+                    if lease is not None:
+                        self._release_lease(lease, worker_dead=True)
+                    else:
+                        w.dead = True
+                        self.workers.pop(w.worker_id, None)
+                        try:
+                            self.idle_workers.remove(w)
+                        except ValueError:
+                            pass
+                    if addr is not None:
+                        try:
+                            await self.gcs.acall(
+                                "NotifyWorkerDeath",
+                                node_id=self.node_id,
+                                worker_id=w.worker_id,
+                                worker_addr=addr,
+                                timeout=10,
+                            )
+                        except Exception:
+                            pass
+            await self._drain_pending()
+
+    async def _idle_reaper_loop(self) -> None:
+        while True:
+            await asyncio.sleep(5)
+            cutoff = time.monotonic() - config.worker_idle_timeout_s
+            keep: List[WorkerHandle] = []
+            for w in self.idle_workers:
+                if w.idle_since < cutoff and len(self.workers) > 1:
+                    w.dead = True
+                    self.workers.pop(w.worker_id, None)
+                    try:
+                        w.proc.terminate()
+                    except Exception:
+                        pass
+                else:
+                    keep.append(w)
+            self.idle_workers = keep
+
+    async def _register(self) -> None:
+        await self.gcs.acall(
+            "RegisterNode",
+            node_id=self.node_id,
+            address=(self.server.host, self.server.port),
+            store_socket=self.store_socket,
+            total_resources=self.resources.total,
+            is_head=self.is_head,
+            labels=self.labels,
+            timeout=30,
+        )
+
+    async def run(self) -> None:
+        # start the native object store daemon for this node
+        from ray_tpu._private.object_store.client import start_store_process
+
+        self.store_proc = start_store_process(self.store_socket, self.store_capacity)
+        self.gcs = RpcClient(self.gcs_addr[0], self.gcs_addr[1])
+
+        server_task = asyncio.ensure_future(self.server.serve_forever())
+        # wait until the port is bound
+        while self.server.port == 0:
+            await asyncio.sleep(0.01)
+        await self._register()
+        asyncio.ensure_future(self._heartbeat_loop())
+        asyncio.ensure_future(self._reap_loop())
+        asyncio.ensure_future(self._idle_reaper_loop())
+        if config.worker_pool_prestart_workers:
+            for _ in range(int(self.resources.total.get("CPU", 1))):
+                self._spawn_worker()
+        try:
+            await server_task
+        finally:
+            self.shutdown_procs()
+
+    def shutdown_procs(self) -> None:
+        for w in self.workers.values():
+            try:
+                w.proc.terminate()
+            except Exception:
+                pass
+        if self.store_proc is not None:
+            try:
+                self.store_proc.terminate()
+            except Exception:
+                pass
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--gcs-addr", required=True)  # host:port
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--resources-json", required=True)
+    parser.add_argument("--store-socket", required=True)
+    parser.add_argument("--store-capacity", type=int, required=True)
+    parser.add_argument("--is-head", action="store_true")
+    parser.add_argument("--session-dir", default="")
+    parser.add_argument("--port-file", default="")
+    parser.add_argument("--log-level", default="INFO")
+    args = parser.parse_args()
+    logging.basicConfig(level=args.log_level, format="[raylet] %(levelname)s %(message)s")
+
+    import json
+
+    host, port_s = args.gcs_addr.rsplit(":", 1)
+    raylet = Raylet(
+        node_id=args.node_id,
+        gcs_addr=(host, int(port_s)),
+        resources=json.loads(args.resources_json),
+        store_socket=args.store_socket,
+        store_capacity=args.store_capacity,
+        port=args.port,
+        is_head=args.is_head,
+        session_dir=args.session_dir,
+    )
+
+    async def _run():
+        task = asyncio.ensure_future(raylet.run())
+        while raylet.server.port == 0:
+            await asyncio.sleep(0.01)
+        if args.port_file:
+            tmp = args.port_file + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(str(raylet.server.port))
+            os.replace(tmp, args.port_file)
+        await task
+
+    try:
+        asyncio.run(_run())
+    except KeyboardInterrupt:
+        pass
+    finally:
+        raylet.shutdown_procs()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,375 @@
+"""Asyncio RPC layer: length-prefixed pickle frames over TCP.
+
+Reference analogue: src/ray/rpc/ (GrpcServer grpc_server.h:93,
+ClientCallManager client_call.h:61, RetryableGrpcClient) — rebuilt on
+asyncio instead of gRPC/protobuf for the Python control plane; the wire
+format is a 4-byte length + 1-byte flags + pickle body. Includes the
+reference's RPC fault-injection hook (rpc_chaos.h:8) driven by the
+``testing_rpc_failure`` config flag ("method=prob" comma list).
+
+Frame layout:
+    request:  [u64 call_id][u8 kind][pickle (method, kwargs)]
+    response: [u64 call_id][u8 kind][pickle (ok, payload)]
+kind: 0 = request, 1 = response, 2 = oneway (no response expected).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import pickle
+import random
+import struct
+import threading
+import time
+from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
+
+from ray_tpu._private.config import config
+
+logger = logging.getLogger(__name__)
+
+KIND_REQUEST = 0
+KIND_RESPONSE = 1
+KIND_ONEWAY = 2
+
+
+class RpcError(Exception):
+    pass
+
+
+class RpcConnectionError(RpcError, ConnectionError):
+    pass
+
+
+class RemoteError(RpcError):
+    """Handler raised; carries the remote traceback string."""
+
+    def __init__(self, message: str):
+        super().__init__(message)
+
+
+def _chaos_should_fail(method: str) -> bool:
+    spec = config.testing_rpc_failure
+    if not spec:
+        return False
+    for part in spec.split(","):
+        if "=" not in part:
+            continue
+        name, prob = part.split("=", 1)
+        if name == method or name == "*":
+            try:
+                return random.random() < float(prob)
+            except ValueError:
+                return False
+    return False
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> Tuple[int, int, bytes]:
+    header = await reader.readexactly(13)
+    (length,) = struct.unpack_from("<I", header, 0)
+    (call_id,) = struct.unpack_from("<Q", header, 4)
+    kind = header[12]
+    body = await reader.readexactly(length)
+    return call_id, kind, body
+
+
+def _write_frame(writer: asyncio.StreamWriter, call_id: int, kind: int, body: bytes) -> None:
+    writer.write(struct.pack("<IQB", len(body), call_id, kind) + body)
+
+
+class EventLoopThread:
+    """A dedicated asyncio loop running on a daemon thread.
+
+    Reference analogue: instrumented_io_context — each component runs its
+    handlers on one loop; we record per-handler latency the same way.
+    """
+
+    _singleton: Optional["EventLoopThread"] = None
+    _singleton_lock = threading.Lock()
+
+    def __init__(self, name: str = "rpc-io"):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self._started = threading.Event()
+        self._thread.start()
+        self._started.wait()
+
+    @classmethod
+    def get_global(cls) -> "EventLoopThread":
+        with cls._singleton_lock:
+            if cls._singleton is None or not cls._singleton._thread.is_alive():
+                cls._singleton = cls("rpc-io-global")
+            return cls._singleton
+
+    def _run(self) -> None:
+        asyncio.set_event_loop(self.loop)
+        # big default executor: sync handlers (task execution, owner object
+        # serving) block threads, and nested tasks must not starve the pool
+        from concurrent.futures import ThreadPoolExecutor
+
+        self.loop.set_default_executor(ThreadPoolExecutor(max_workers=128, thread_name_prefix="rpc-exec"))
+        self._started.set()
+        self.loop.run_forever()
+
+    def run_coro(self, coro: Awaitable, timeout: Optional[float] = None) -> Any:
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def call_soon(self, cb: Callable, *args) -> None:
+        self.loop.call_soon_threadsafe(cb, *args)
+
+    def stop(self) -> None:
+        self.loop.call_soon_threadsafe(self.loop.stop)
+
+
+class RpcServer:
+    """Serve registered handlers. Handlers may be sync or async; they run on
+    the server's event loop (async) or a thread pool (sync)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, name: str = "rpc"):
+        self.host = host
+        self.port = port
+        self.name = name
+        self._handlers: Dict[str, Callable] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._loop_thread: Optional[EventLoopThread] = None
+        self._handler_stats: Dict[str, Tuple[int, float]] = {}
+
+    def register(self, method: str, handler: Callable) -> None:
+        self._handlers[method] = handler
+
+    def register_instance(self, obj: Any, prefix: str = "") -> None:
+        """Register every public method of ``obj`` as a handler."""
+        for name in dir(obj):
+            if name.startswith("_"):
+                continue
+            fn = getattr(obj, name)
+            if callable(fn):
+                self._handlers[prefix + name] = fn
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self, loop_thread: Optional[EventLoopThread] = None) -> Tuple[str, int]:
+        self._loop_thread = loop_thread or EventLoopThread(name=f"{self.name}-io")
+        self._loop_thread.run_coro(self._start_async())
+        return self.host, self.port
+
+    async def _start_async(self) -> None:
+        self._server = await asyncio.start_server(self._on_client, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def serve_forever(self) -> None:
+        """For processes whose main thread is the event loop."""
+        self._server = await asyncio.start_server(self._on_client, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        async with self._server:
+            await self._server.serve_forever()
+
+    def stop(self) -> None:
+        if self._loop_thread and self._server:
+            async def _close():
+                self._server.close()
+
+            try:
+                self._loop_thread.run_coro(_close(), timeout=5)
+            except Exception:
+                pass
+
+    # -- serving ----------------------------------------------------------
+    async def _on_client(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                call_id, kind, body = await _read_frame(reader)
+                asyncio.ensure_future(self._dispatch(call_id, kind, body, writer))
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        except Exception:
+            logger.exception("%s: connection handler error", self.name)
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(self, call_id: int, kind: int, body: bytes, writer: asyncio.StreamWriter) -> None:
+        t0 = time.monotonic()
+        method = "?"
+        try:
+            method, kwargs = pickle.loads(body)
+            if _chaos_should_fail(method):
+                logger.warning("chaos: dropping rpc %s", method)
+                return  # simulate lost request
+            handler = self._handlers.get(method)
+            if handler is None:
+                raise RpcError(f"{self.name}: no handler for {method!r}")
+            if asyncio.iscoroutinefunction(handler):
+                result = await handler(**kwargs)
+            else:
+                result = await asyncio.get_event_loop().run_in_executor(
+                    None, lambda: handler(**kwargs)
+                )
+            if kind == KIND_ONEWAY:
+                return
+            payload = pickle.dumps((True, result), protocol=5)
+        except Exception as e:  # noqa: BLE001
+            if kind == KIND_ONEWAY:
+                logger.exception("%s: oneway handler %s failed", self.name, method)
+                return
+            import traceback
+
+            payload = pickle.dumps((False, f"{type(e).__name__}: {e}\n{traceback.format_exc()}"), protocol=5)
+        dt = time.monotonic() - t0
+        if dt * 1000 > config.event_loop_slow_handler_ms:
+            logger.warning("%s: slow handler %s took %.1fms", self.name, method, dt * 1000)
+        try:
+            _write_frame(writer, call_id, KIND_RESPONSE, payload)
+            await writer.drain()
+        except (ConnectionError, RuntimeError):
+            pass
+
+
+class RpcClient:
+    """Persistent connection with pipelined calls + reconnect/retry."""
+
+    def __init__(self, host: str, port: int, loop_thread: Optional[EventLoopThread] = None):
+        self.host = host
+        self.port = port
+        self._loop_thread = loop_thread or EventLoopThread.get_global()
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._reader_task: Optional[asyncio.Task] = None
+        self._conn_lock: Optional[asyncio.Lock] = None
+
+    # -- async internals --------------------------------------------------
+    async def _ensure_connected(self) -> None:
+        if self._conn_lock is None:
+            self._conn_lock = asyncio.Lock()
+        async with self._conn_lock:
+            if self._writer is not None and not self._writer.is_closing():
+                return
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(self.host, self.port),
+                timeout=config.rpc_connect_timeout_s,
+            )
+            self._writer = writer
+            self._reader_task = asyncio.ensure_future(self._read_loop(reader))
+
+    async def _read_loop(self, reader: asyncio.StreamReader) -> None:
+        try:
+            while True:
+                call_id, kind, body = await _read_frame(reader)
+                fut = self._pending.pop(call_id, None)
+                if fut is not None and not fut.done():
+                    fut.set_result(body)
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            self._writer = None
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(RpcConnectionError(f"connection to {self.host}:{self.port} lost"))
+            self._pending.clear()
+
+    async def _call_async(self, method: str, kwargs: dict, oneway: bool, timeout: Optional[float]) -> Any:
+        """Must run on self._loop_thread.loop — all connection state
+        (writer, pending futures, read loop) is affine to that loop."""
+        if timeout is not None and timeout < 0:
+            timeout = None  # negative = wait forever (long-running tasks)
+        await self._ensure_connected()
+        with self._lock:
+            self._next_id += 1
+            call_id = self._next_id
+        body = pickle.dumps((method, kwargs), protocol=5)
+        if oneway:
+            _write_frame(self._writer, call_id, KIND_ONEWAY, body)
+            await self._writer.drain()
+            return None
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pending[call_id] = fut
+        _write_frame(self._writer, call_id, KIND_REQUEST, body)
+        await self._writer.drain()
+        body = await asyncio.wait_for(fut, timeout=timeout)
+        ok, payload = pickle.loads(body)
+        if not ok:
+            raise RemoteError(payload)
+        return payload
+
+    # -- public sync API --------------------------------------------------
+    def call(self, method: str, timeout: Optional[float] = None, **kwargs) -> Any:
+        timeout = timeout if timeout is not None else config.rpc_call_timeout_s
+        outer = None if timeout < 0 else timeout + 5
+        return self._loop_thread.run_coro(
+            self._call_async(method, kwargs, oneway=False, timeout=timeout),
+            timeout=outer,
+        )
+
+    def call_retrying(self, method: str, timeout: Optional[float] = None, **kwargs) -> Any:
+        """Retry on connection errors with exponential backoff (reference:
+        retryable_grpc_client.h)."""
+        delay = config.rpc_retry_base_delay_ms / 1000.0
+        last: Optional[Exception] = None
+        for _ in range(max(1, config.rpc_max_retries)):
+            try:
+                return self.call(method, timeout=timeout, **kwargs)
+            except (RpcConnectionError, ConnectionError, asyncio.TimeoutError, TimeoutError, OSError) as e:
+                last = e
+                time.sleep(delay)
+                delay = min(delay * 2, config.rpc_retry_max_delay_ms / 1000.0)
+        raise RpcConnectionError(f"rpc {method} to {self.host}:{self.port} failed after retries: {last}")
+
+    def call_oneway(self, method: str, **kwargs) -> None:
+        self._loop_thread.run_coro(
+            self._call_async(method, kwargs, oneway=True, timeout=None), timeout=30
+        )
+
+    async def acall(self, method: str, timeout: Optional[float] = None, **kwargs) -> Any:
+        """Async call, safe from ANY event loop: the I/O always executes on
+        this client's owning loop (cross-loop use of one cached client was a
+        silent-hang bug — futures created on loop A resolved from loop B
+        never wake A)."""
+        timeout = timeout if timeout is not None else config.rpc_call_timeout_s
+        running = asyncio.get_event_loop()
+        if running is self._loop_thread.loop:
+            return await self._call_async(method, kwargs, oneway=False, timeout=timeout)
+        cf = asyncio.run_coroutine_threadsafe(
+            self._call_async(method, kwargs, oneway=False, timeout=timeout),
+            self._loop_thread.loop,
+        )
+        return await asyncio.wrap_future(cf)
+
+    def close(self) -> None:
+        w = self._writer
+
+        async def _close():
+            if w is not None:
+                try:
+                    w.close()
+                except Exception:
+                    pass
+
+        try:
+            self._loop_thread.run_coro(_close(), timeout=5)
+        except Exception:
+            pass
+
+
+_client_cache: Dict[Tuple[str, int], RpcClient] = {}
+_client_cache_lock = threading.Lock()
+
+
+def get_client(addr: Tuple[str, int]) -> RpcClient:
+    """Process-wide client cache — one connection per peer."""
+    with _client_cache_lock:
+        c = _client_cache.get(addr)
+        if c is None:
+            c = RpcClient(addr[0], addr[1])
+            _client_cache[addr] = c
+        return c
+
+
+def clear_client_cache() -> None:
+    with _client_cache_lock:
+        for c in _client_cache.values():
+            c.close()
+        _client_cache.clear()

@@ -1,0 +1,301 @@
+"""Worker process entry point — executes tasks and hosts actors.
+
+Reference: python/ray/_private/workers/default_worker.py:23 (worker entry)
++ the execution side of src/ray/core_worker/task_execution/ (TaskReceiver
+task_receiver.h:43, ordered actor queues, ConcurrencyGroupManager) and the
+Cython task_execution_handler (_raylet.pyx:2318).
+
+The worker:
+- registers with its raylet, serves PushTask / CreateActor / PushActorTask,
+- owns a CoreWorker so user tasks can submit nested tasks / put objects,
+- applies lease context (TPU_VISIBLE_CHIPS) before running user code,
+- orders actor tasks per caller by sequence number (reference:
+  sequential_actor_submit_queue.cc semantics).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.config import config
+from ray_tpu._private.core_worker import CoreWorker
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.rpc import RpcClient, get_client
+from ray_tpu._private.serialization import deserialize, loads_function, serialize
+from ray_tpu.exceptions import RayActorError, RayTaskError
+
+logger = logging.getLogger("ray_tpu.worker")
+
+
+def _unpack_arg(a: dict) -> Any:
+    if a["is_ref"]:
+        ref = ObjectRef(ObjectID(a["object_id"]), owner_addr=tuple(a["owner_addr"]) if a["owner_addr"] else None)
+        return ("__ref__", ref)
+    return ("__val__", a["value"])
+
+
+class _ActorRunner:
+    """Hosts one actor instance: per-caller seqno ordering + concurrency pool."""
+
+    def __init__(self, actor_id: str, instance: Any, max_concurrency: int):
+        self.actor_id = actor_id
+        self.instance = instance
+        self.max_concurrency = max(1, max_concurrency)
+        self.pool = ThreadPoolExecutor(max_workers=self.max_concurrency, thread_name_prefix=f"actor-{actor_id[:8]}")
+        self.lock = threading.Lock()
+        self.next_seqno: Dict[str, int] = {}
+        self.buffered: Dict[str, Dict[int, Tuple[dict, "queue.Queue"]]] = {}
+        self.dead = False
+
+    def submit(self, payload: dict, reply_q: "queue.Queue") -> None:
+        caller = payload["caller_id"]
+        seqno = payload["seqno"]
+        # pool.submit must happen under the lock: releasing it first lets a
+        # later seqno reach the executor queue before an earlier one
+        with self.lock:
+            expected = self.next_seqno.get(caller, 0)
+            if seqno != expected:
+                self.buffered.setdefault(caller, {})[seqno] = (payload, reply_q)
+                return
+            self.next_seqno[caller] = expected + 1
+            self.pool.submit(self._run, payload, reply_q)
+            while True:
+                nxt = self.next_seqno[caller]
+                entry = self.buffered.get(caller, {}).pop(nxt, None)
+                if entry is None:
+                    break
+                self.next_seqno[caller] = nxt + 1
+                self.pool.submit(self._run, entry[0], entry[1])
+
+    def _run(self, payload: dict, reply_q: "queue.Queue") -> None:
+        reply_q.put(_execute_callable(
+            lambda args, kwargs: getattr(self.instance, payload["method_name"])(*args, **kwargs),
+            payload["args"],
+            payload["kwargs"],
+            payload["num_returns"],
+            TaskID(payload["task_id"]),
+            payload["method_name"],
+            actor_id=ActorID.from_hex(payload["actor_id"]),
+        ))
+
+
+def _resolve_args(packed_args: List[dict], packed_kwargs: Dict[str, dict]) -> Tuple[tuple, dict]:
+    w = worker_mod.global_worker
+    args = []
+    for a in packed_args:
+        kind, v = _unpack_arg(a)
+        if kind == "__ref__":
+            args.append(w.core.get([v])[0])
+        else:
+            args.append(deserialize(v))
+    kwargs = {}
+    for k, a in packed_kwargs.items():
+        kind, v = _unpack_arg(a)
+        kwargs[k] = w.core.get([v])[0] if kind == "__ref__" else deserialize(v)
+    return tuple(args), kwargs
+
+
+def _execute_callable(
+    fn,
+    packed_args: List[dict],
+    packed_kwargs: Dict[str, dict],
+    num_returns: int,
+    task_id: TaskID,
+    name: str,
+    actor_id: Optional[ActorID] = None,
+) -> dict:
+    """Run user code; package returns (inline small / shared-memory big)."""
+    w = worker_mod.global_worker
+    w.set_task_context(task_id, actor_id)
+    try:
+        args, kwargs = _resolve_args(packed_args, packed_kwargs)
+        result = fn(args, kwargs)
+        if num_returns == 1:
+            values = [result]
+        else:
+            values = list(result)
+            if len(values) != num_returns:
+                raise ValueError(f"expected {num_returns} return values, got {len(values)}")
+        returns = []
+        for i, v in enumerate(values):
+            data = serialize(v)
+            if len(data) <= config.object_store_inline_max_bytes:
+                returns.append({"kind": "inline", "data": data})
+            else:
+                oid = ObjectID.from_index(task_id, i + 1)
+                try:
+                    w.core.plasma.put_bytes(oid, data)
+                except FileExistsError:
+                    pass
+                returns.append({"kind": "plasma", "node_id": w.core.node_id})
+        return {"returns": returns}
+    except BaseException as e:  # noqa: BLE001
+        tb = traceback.format_exc()
+        err = RayTaskError(name, tb, e if isinstance(e, Exception) else None)
+        data = serialize(err)
+        return {
+            "returns": [{"kind": "inline", "data": data} for _ in range(num_returns)],
+            "retriable_error": True,
+        }
+    finally:
+        w.set_task_context(None, None)
+
+
+class WorkerServer:
+    def __init__(self, core: CoreWorker, raylet_addr: Tuple[str, int], worker_id: str):
+        self.core = core
+        self.worker_id = worker_id
+        self.raylet_addr = raylet_addr
+        self.actors: Dict[str, _ActorRunner] = {}
+        self._task_pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="exec")
+        self._function_cache: Dict[bytes, Any] = {}
+        core.server.register("PushTask", self.PushTask)
+        core.server.register("CreateActor", self.CreateActor)
+        core.server.register("PushActorTask", self.PushActorTask)
+        core.server.register("KillActor", self.KillActor)
+        core.server.register("SetLeaseContext", self.SetLeaseContext)
+        core.server.register("Exit", self.Exit)
+
+    # -- lease context: assign TPU chips before user code runs ----------
+    def SetLeaseContext(self, lease_id: str, tpu_chips: List[int], resources: Dict[str, float]) -> dict:
+        from ray_tpu.accelerators.tpu import TPUAcceleratorManager
+
+        if tpu_chips:
+            TPUAcceleratorManager.set_current_process_visible_accelerator_ids(
+                [str(c) for c in tpu_chips]
+            )
+            os.environ["JAX_PLATFORMS"] = ""  # let jax pick up the TPU
+        w = worker_mod.global_worker
+        w.assigned_resources = dict(resources)
+        w.assigned_resources["tpu_chips"] = list(tpu_chips)
+        w.current_lease_id = lease_id
+        return {"ok": True}
+
+    @staticmethod
+    def _apply_py_paths(paths) -> None:
+        import sys
+
+        for p in paths or []:
+            if p not in sys.path:
+                sys.path.append(p)
+
+    # -- normal tasks ---------------------------------------------------
+    def PushTask(self, spec_payload: dict) -> dict:
+        self._apply_py_paths(spec_payload.get("py_paths"))
+        fn_bytes = spec_payload["serialized_function"]
+        fn = self._function_cache.get(fn_bytes)
+        if fn is None:
+            try:
+                fn = loads_function(fn_bytes)
+            except BaseException as e:  # noqa: BLE001
+                err = serialize(
+                    RayTaskError(
+                        spec_payload["function_name"],
+                        f"Failed to deserialize the remote function: "
+                        f"{type(e).__name__}: {e}\n{traceback.format_exc()}",
+                    )
+                )
+                return {
+                    "returns": [
+                        {"kind": "inline", "data": err}
+                        for _ in range(spec_payload["num_returns"])
+                    ]
+                }
+            self._function_cache[fn_bytes] = fn
+        fut = self._task_pool.submit(
+            _execute_callable,
+            lambda args, kwargs: fn(*args, **kwargs),
+            spec_payload["args"],
+            spec_payload["kwargs"],
+            spec_payload["num_returns"],
+            TaskID(spec_payload["task_id"]),
+            spec_payload["function_name"],
+        )
+        return fut.result()
+
+    # -- actors ---------------------------------------------------------
+    def CreateActor(self, actor_id: str, serialized_spec: bytes) -> dict:
+        import pickle
+
+        spec = pickle.loads(serialized_spec)
+        self._apply_py_paths(spec.get("py_paths"))
+        try:
+            cls = loads_function(spec["serialized_class"])
+            args, kwargs = _resolve_args(spec["args"], spec["kwargs"])
+            instance = cls(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001
+            return {"ok": False, "error": f"{type(e).__name__}: {e}\n{traceback.format_exc()}"}
+        self.actors[actor_id] = _ActorRunner(actor_id, instance, spec.get("max_concurrency", 1))
+        return {"ok": True}
+
+    def PushActorTask(self, payload: dict) -> dict:
+        runner = self.actors.get(payload["actor_id"])
+        if runner is None or runner.dead:
+            err = serialize(RayActorError(f"Actor {payload['actor_id'][:12]} is not on this worker"))
+            return {"returns": [{"kind": "inline", "data": err} for _ in range(payload["num_returns"])]}
+        reply_q: "queue.Queue" = queue.Queue()
+        runner.submit(payload, reply_q)
+        return reply_q.get()
+
+    def KillActor(self, actor_id: str) -> dict:
+        runner = self.actors.pop(actor_id, None)
+        if runner is not None:
+            runner.dead = True
+            runner.pool.shutdown(wait=False, cancel_futures=True)
+            # a dedicated-actor worker exits so its resources free up
+            if not self.actors:
+                threading.Timer(0.2, lambda: os._exit(0)).start()
+        return {"ok": True}
+
+    def Exit(self) -> dict:
+        threading.Timer(0.1, lambda: os._exit(0)).start()
+        return {"ok": True}
+
+
+def main() -> None:
+    logging.basicConfig(level="INFO", format="[worker] %(levelname)s %(message)s")
+    worker_id = os.environ["RAY_TPU_WORKER_ID"]
+    raylet_host, raylet_port = os.environ["RAY_TPU_RAYLET_ADDR"].rsplit(":", 1)
+    gcs_host, gcs_port = os.environ["RAY_TPU_GCS_ADDR"].rsplit(":", 1)
+    store_socket = os.environ["RAY_TPU_STORE_SOCKET"]
+    node_id = os.environ["RAY_TPU_NODE_ID"]
+    config.from_json(os.environ.get("RAY_TPU_CONFIG_JSON", "{}"))
+
+    w = worker_mod.Worker()
+    w.mode = worker_mod.WORKER_MODE
+    worker_mod.global_worker = w
+
+    core = CoreWorker(
+        gcs_addr=(gcs_host, int(gcs_port)),
+        raylet_addr=(raylet_host, int(raylet_port)),
+        store_socket=store_socket,
+        node_id=node_id,
+        job_id=JobID.from_int(0),
+        is_driver=False,
+        worker_id_hex=worker_id,
+    )
+    w.core = core
+    w.reference_counter.set_on_zero_callback(core.free_object)
+    WorkerServer(core, (raylet_host, int(raylet_port)), worker_id)
+
+    raylet = RpcClient(raylet_host, int(raylet_port), core.loop_thread)
+    reply = raylet.call_retrying("RegisterWorker", worker_id=worker_id, addr=core.address)
+    if not reply.get("ok"):
+        logger.error("raylet rejected registration")
+        return
+    logger.info("worker %s serving at %s", worker_id[:8], core.address)
+
+    # block forever; raylet owns our lifetime
+    threading.Event().wait()
+
+
+if __name__ == "__main__":
+    main()

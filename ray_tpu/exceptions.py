@@ -20,6 +20,9 @@ class RayTaskError(RayTpuError):
         self.cause = cause
         super().__init__(f"Task '{function_name}' failed:\n{traceback_str}")
 
+    def __reduce__(self):
+        return (RayTaskError, (self.function_name, self.traceback_str, self.cause))
+
     def as_instanceof_cause(self) -> Exception:
         """Return an exception that is an instance of the cause's class."""
         if self.cause is None:

@@ -1,0 +1,152 @@
+"""Multi-process cluster runtime tests (GCS + raylet + shared-memory store +
+worker processes). Reference test model: python/ray/tests/test_basic.py over
+a real (single-node) runtime.
+
+One module-scoped cluster: worker spawn is ~2s/proc on 1 vCPU, so tests
+share it.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=3, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_task_roundtrip(cluster):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2), timeout=60) == 3
+    refs = [add.remote(i, i) for i in range(20)]
+    assert sum(ray_tpu.get(refs, timeout=60)) == 2 * sum(range(20))
+
+
+def test_nested_refs_as_args(cluster):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    r = add.remote(add.remote(1, 1), add.remote(2, 2))
+    assert ray_tpu.get(r, timeout=60) == 6
+
+
+def test_big_object_through_shared_memory(cluster):
+    x = np.arange(500_000, dtype=np.float64)
+    ref = ray_tpu.put(x)
+    y = ray_tpu.get(ref, timeout=60)
+    assert (x == y).all()
+
+    @ray_tpu.remote
+    def total(a):
+        return float(a.sum())
+
+    assert ray_tpu.get(total.remote(ref), timeout=60) == float(x.sum())
+
+
+def test_big_return(cluster):
+    @ray_tpu.remote
+    def make(n):
+        return np.ones(n, dtype=np.float32)
+
+    y = ray_tpu.get(make.remote(400_000), timeout=60)
+    assert y.shape == (400_000,)
+    assert y.dtype == np.float32
+
+
+def test_error_propagation(cluster):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("cluster boom")
+
+    with pytest.raises(ValueError, match="cluster boom"):
+        ray_tpu.get(boom.remote(), timeout=60)
+
+
+def test_actor_lifecycle(cluster):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def incr(self, by=1):
+            self.n += by
+            return self.n
+
+    c = Counter.remote(100)
+    refs = [c.incr.remote() for _ in range(25)]
+    assert ray_tpu.get(refs, timeout=60)[-1] == 125
+    # ordering preserved
+    assert ray_tpu.get(refs, timeout=60) == list(range(101, 126))
+
+
+def test_actor_error_and_kill(cluster):
+    @ray_tpu.remote
+    class Bad:
+        def fail(self):
+            raise RuntimeError("actor fail")
+
+        def ok(self):
+            return 1
+
+    b = Bad.remote()
+    with pytest.raises(RuntimeError, match="actor fail"):
+        ray_tpu.get(b.fail.remote(), timeout=60)
+    # actor still alive after a method error
+    assert ray_tpu.get(b.ok.remote(), timeout=60) == 1
+    ray_tpu.kill(b)
+    time.sleep(0.5)
+    with pytest.raises(ray_tpu.exceptions.RayActorError):
+        ray_tpu.get(b.ok.remote(), timeout=30)
+
+
+def test_named_actor_cluster(cluster):
+    @ray_tpu.remote
+    class Svc:
+        def ping(self):
+            return "pong"
+
+    Svc.options(name="cluster-svc").remote()
+    h = ray_tpu.get_actor("cluster-svc")
+    assert ray_tpu.get(h.ping.remote(), timeout=60) == "pong"
+
+
+def test_wait_cluster(cluster):
+    @ray_tpu.remote
+    def sleepy(t):
+        time.sleep(t)
+        return t
+
+    fast = sleepy.remote(0.1)
+    slow = sleepy.remote(10.0)
+    ready, not_ready = ray_tpu.wait([fast, slow], num_returns=1, timeout=8.0)
+    assert ready == [fast]
+    assert not_ready == [slow]
+
+
+def test_nested_task_submission(cluster):
+    @ray_tpu.remote
+    def inner(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def outer(x):
+        import ray_tpu as rt
+
+        return rt.get(inner.remote(x)) + 1
+
+    assert ray_tpu.get(outer.remote(10), timeout=90) == 21
+
+
+def test_cluster_resources_visible(cluster):
+    res = ray_tpu.cluster_resources()
+    assert res["CPU"] == 3.0

@@ -1,0 +1,161 @@
+"""Native shared-memory object store tests.
+
+Reference test model: src/ray/object_manager/plasma/ store tests +
+python/ray/tests/test_plasma* — create/seal/get/release/delete, blocking
+get, LRU eviction under pressure, allocator reuse.
+"""
+
+import os
+import tempfile
+import threading
+import time
+
+import pytest
+
+from ray_tpu._private.ids import JobID, ObjectID, TaskID
+from ray_tpu._private.object_store.client import (
+    StoreClient,
+    start_store_process,
+)
+from ray_tpu.exceptions import ObjectStoreFullError
+
+
+_TID = TaskID.for_normal_task(JobID.from_int(1))
+
+
+def _oid(i: int) -> ObjectID:
+    return ObjectID.from_index(_TID, i)
+
+
+@pytest.fixture
+def store():
+    d = tempfile.mkdtemp()
+    sock = os.path.join(d, "store.sock")
+    proc = start_store_process(sock, 8 * 1024 * 1024)  # 8 MiB
+    client = StoreClient(sock)
+    yield client
+    client.close()
+    proc.terminate()
+    proc.wait(timeout=5)
+
+
+def test_put_get_roundtrip(store):
+    oid = _oid(1)
+    store.put_bytes(oid, b"hello world")
+    [view] = store.get([oid])
+    assert bytes(view) == b"hello world"
+    store.release(oid)
+
+
+def test_zero_copy_shared_memory(store):
+    oid = _oid(1)
+    data = os.urandom(1024 * 1024)
+    store.put_bytes(oid, data)
+    # second client maps the same pool
+    [v] = store.get([oid])
+    assert bytes(v) == data
+    store.release(oid)
+
+
+def test_contains_and_delete(store):
+    oid = _oid(1)
+    assert not store.contains(oid)
+    store.put_bytes(oid, b"x" * 100)
+    assert store.contains(oid)
+    store.delete(oid)
+    assert not store.contains(oid)
+
+
+def test_create_exists(store):
+    oid = _oid(1)
+    store.put_bytes(oid, b"a")
+    with pytest.raises(FileExistsError):
+        store.create(oid, 10)
+
+
+def test_get_blocks_until_seal(store):
+    oid = _oid(1)
+    results = {}
+
+    def getter():
+        [v] = store2.get([oid], timeout_ms=5000)
+        results["v"] = bytes(v) if v is not None else None
+
+    # separate connection for the blocking get
+    store2 = StoreClient(store._sock.getpeername())
+    t = threading.Thread(target=getter)
+    t.start()
+    time.sleep(0.1)
+    buf = store.create(oid, 5)
+    buf.data[:] = b"12345"
+    time.sleep(0.1)
+    assert "v" not in results  # still unsealed
+    buf.seal()
+    t.join(timeout=5)
+    assert results["v"] == b"12345"
+    store2.close()
+
+
+def test_get_timeout(store):
+    oid = _oid(99)
+    t0 = time.monotonic()
+    [v] = store.get([oid], timeout_ms=200)
+    assert v is None
+    assert 0.1 < time.monotonic() - t0 < 2.0
+
+
+def test_lru_eviction_under_pressure(store):
+    # capacity 8 MiB; insert 20 x 1 MiB -> old unpinned objects evicted
+    chunk = b"z" * (1024 * 1024)
+    for i in range(1, 21):
+        store.put_bytes(_oid(i), chunk)
+    m = store.metrics()
+    assert m["num_evictions"] > 0
+    assert m["allocated"] <= m["capacity"]
+    # most recent object still present
+    assert store.contains(_oid(20))
+    # oldest evicted
+    assert not store.contains(_oid(1))
+
+
+def test_pinned_objects_not_evicted(store):
+    oid = _oid(1)
+    store.put_bytes(oid, b"p" * (1024 * 1024))
+    [view] = store.get([oid])  # pin it
+    for i in range(2, 20):
+        store.put_bytes(_oid(i), b"z" * (1024 * 1024))
+    assert store.contains(oid)  # survived pressure because pinned
+    assert bytes(view[:1]) == b"p"
+    store.release(oid)
+
+
+def test_store_full_when_all_pinned(store):
+    views = []
+    for i in range(1, 8):
+        store.put_bytes(_oid(i), b"q" * (1024 * 1024))
+        views.append(store.get([_oid(i)])[0])
+    with pytest.raises(ObjectStoreFullError):
+        store.put_bytes(_oid(100), b"w" * (4 * 1024 * 1024))
+    for i in range(1, 8):
+        store.release(_oid(i))
+
+
+def test_allocator_reuse_after_delete(store):
+    # fill, delete, refill — allocator must coalesce and reuse space
+    for round_ in range(5):
+        for i in range(1, 8):
+            store.put_bytes(_oid(i), b"r" * (1024 * 1024))
+        for i in range(1, 8):
+            store.delete(_oid(i))
+    m = store.metrics()
+    assert m["num_objects"] == 0
+    assert m["allocated"] == 0
+
+
+def test_abort_unsealed(store):
+    oid = _oid(1)
+    buf = store.create(oid, 1000)
+    buf.abort()
+    assert not store.contains(oid)
+    m = store.metrics()
+    assert m["allocated"] == 0
