@@ -73,18 +73,15 @@ class ClusterRuntime(CoreWorker):
             local = next((n for n in nodes if n["Alive"]), None)
             if local is None:
                 raise RuntimeError("no alive nodes in cluster")
-            raylet_addr = (n_addr := (local["NodeManagerAddress"], local["NodeManagerPort"]))
+            raylet_addr = (local["NodeManagerAddress"], local["NodeManagerPort"])
             store_socket = local["ObjectStoreSocketName"]
             node_id = local["NodeID"]
             gcs.close()
 
         # register the driver's job
-        tmp_gcs = RpcClient(gcs_addr[0], gcs_addr[1])
-        # driver address not yet known (CoreWorker not built) — register after
         runtime = cls(node, gcs_addr, raylet_addr, store_socket, node_id, JobID.from_int(0))
         reply = runtime.gcs.call_retrying("RegisterJob", driver_addr=runtime.address, metadata={})
         runtime.job_id = JobID.from_int(reply["job_id_int"])
-        tmp_gcs.close()
         return runtime
 
     def shutdown(self) -> None:

@@ -62,6 +62,9 @@ _DEFINITIONS: Dict[str, Tuple[type, Any]] = {
     "task_max_retries_default": (int, 3),
     "actor_max_restarts_default": (int, 0),
     "max_pending_lease_requests_per_class": (int, 10),
+    # how long a caller keeps resending an un-acked actor task while the
+    # actor is unreachable/restarting before failing it
+    "actor_task_resend_timeout_s": (float, 60.0),
     # --- tpu ---
     "tpu_chips_per_host_default": (int, 4),
     "megascale_port": (int, 8081),

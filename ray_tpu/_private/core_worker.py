@@ -20,6 +20,7 @@ Object entry formats in the owner memory store:
 from __future__ import annotations
 
 import logging
+import queue as queue_mod
 import threading
 import time
 import uuid
@@ -73,6 +74,233 @@ class _LeaseEntry:
         self.last_used = time.monotonic()
 
 
+class _ActorDispatcher:
+    """Ordered per-actor task dispatch (reference: ActorTaskSubmitter,
+    actor_task_submitter.cc:167 SubmitTask / :534 SendPendingTasks).
+
+    One thread per (caller, actor). Tasks are sent in submission order and
+    the thread blocks on the *enqueue ack* (not execution), so per-caller
+    ordering holds without seqno windows — and therefore survives actor
+    restarts, where a fresh worker would otherwise wait forever for
+    pre-restart seqnos it never saw. Execution results come back
+    asynchronously via the caller's ``ActorTaskDone`` RPC. While tasks are
+    pending the same thread polls actor state so tasks lost to a dead
+    incarnation fail promptly instead of hanging.
+    """
+
+    _POLL_INTERVAL_S = 1.0
+    # pending tasks older than this on a healthy actor are re-queried at the
+    # worker (covers a lost ActorTaskDone delivery)
+    _REQUERY_AGE_S = 10.0
+
+    def __init__(self, core: "CoreWorker", aid: str):
+        self.core = core
+        self.aid = aid
+        self.queue: "queue_mod.Queue" = queue_mod.Queue()
+        self._dead = False
+        self._state_lock = threading.Lock()
+        self.thread = threading.Thread(
+            target=self._loop, daemon=True, name=f"actor-dispatch-{aid[:8]}"
+        )
+        self.thread.start()
+
+    def submit(self, payload: dict, return_oids: List[ObjectID]) -> None:
+        with self._state_lock:
+            if not self._dead:
+                self.queue.put((payload, return_oids))
+                return
+        self.core._fail_actor_task(
+            TaskID(payload["task_id"]), return_oids,
+            ActorDiedError(f"Actor {self.aid[:12]} is dead"),
+        )
+
+    def stop(self) -> None:
+        self.queue.put(None)
+
+    # -- internals ------------------------------------------------------
+    def _has_pending(self) -> bool:
+        with self.core._actor_pending_lock:
+            return any(
+                info["aid"] == self.aid
+                for info in self.core._pending_actor_tasks.values()
+            )
+
+    def _loop(self) -> None:
+        last_poll = 0.0
+        while not self.core._shutdown:
+            try:
+                item = self.queue.get(timeout=self._POLL_INTERVAL_S)
+            except queue_mod.Empty:
+                item = ()
+            now = time.monotonic()
+            if now - last_poll >= self._POLL_INTERVAL_S and self._has_pending():
+                try:
+                    self._poll_actor_state()
+                except Exception:  # noqa: BLE001 — poll is advisory
+                    pass
+                last_poll = now
+            if item == ():
+                continue
+            if item is None:
+                return
+            try:
+                self._send_one(*item)
+            except BaseException as e:  # noqa: BLE001 — the thread must survive
+                logger.exception("actor dispatch failed for %s", self.aid[:12])
+                self.core._fail_actor_task(
+                    TaskID(item[0]["task_id"]), item[1],
+                    RayActorError(f"Failed to dispatch task to actor {self.aid[:12]}: {e!r}"),
+                )
+            if self._dead:
+                self._retire()
+                return
+
+    def _retire(self) -> None:
+        """Actor is DEAD: fail queued work, deregister, end the thread."""
+        with self._state_lock:
+            self._dead = True
+            items = []
+            while True:
+                try:
+                    items.append(self.queue.get_nowait())
+                except queue_mod.Empty:
+                    break
+        err = ActorDiedError(f"Actor {self.aid[:12]} is dead")
+        for item in items:
+            if item:
+                self.core._fail_actor_task(TaskID(item[0]["task_id"]), item[1], err)
+        with self.core._actor_disp_lock:
+            if self.core._actor_dispatchers.get(self.aid) is self:
+                del self.core._actor_dispatchers[self.aid]
+
+    def _send_one(self, payload: dict, return_oids: List[ObjectID]) -> None:
+        tid = TaskID(payload["task_id"])
+        deadline = time.monotonic() + config.actor_task_resend_timeout_s
+        while True:
+            try:
+                addr = self.core._resolve_actor(self.aid)
+            except ActorDiedError as e:
+                self._dead = True
+                self.core._fail_actor_task(tid, return_oids, e)
+                return
+            except (ActorUnavailableError, RayActorError) as e:
+                self.core._fail_actor_task(tid, return_oids, e)
+                return
+            except Exception as e:  # noqa: BLE001 — e.g. GCS briefly down
+                if time.monotonic() > deadline:
+                    self.core._fail_actor_task(
+                        tid, return_oids,
+                        RayActorError(f"Could not resolve actor {self.aid[:12]}: {e}"),
+                    )
+                    return
+                time.sleep(0.5)
+                continue
+            # register pending BEFORE the push: the done RPC can arrive
+            # before the enqueue ack returns
+            with self.core._actor_pending_lock:
+                self.core._pending_actor_tasks[tid] = {
+                    "aid": self.aid,
+                    "return_oids": return_oids,
+                    "addr": addr,
+                    "ts": time.monotonic(),
+                }
+            try:
+                reply = get_client(addr).call(
+                    "PushActorTask", payload=payload, timeout=30
+                )
+            except (RpcConnectionError, ConnectionError, OSError, TimeoutError) as e:
+                with self.core._actor_pending_lock:
+                    self.core._pending_actor_tasks.pop(tid, None)
+                # The push may or may not have reached the worker before the
+                # connection broke, so resending could execute it twice.
+                # Actor tasks are at-most-once (reference: actor tasks are
+                # not retried unless max_task_retries > 0) — report the
+                # fault (triggers restart per max_restarts) and fail THIS
+                # task; queued successors will reach the new incarnation.
+                self.core._report_actor_fault(self.aid, addr, str(e))
+                self.core._fail_actor_task(
+                    tid,
+                    return_oids,
+                    RayActorError(
+                        f"Actor {self.aid[:12]} became unreachable while "
+                        f"task {tid.hex()[:12]} was being delivered: {e}"
+                    ),
+                )
+                return
+            if not reply.get("accepted"):
+                # live worker without this actor: stale address (restart)
+                with self.core._actor_pending_lock:
+                    self.core._pending_actor_tasks.pop(tid, None)
+                self.core._invalidate_actor_addr(self.aid, addr)
+                if time.monotonic() > deadline:
+                    self.core._fail_actor_task(
+                        tid, return_oids,
+                        RayActorError(f"Actor {self.aid[:12]} not reachable at a stable address"),
+                    )
+                    return
+                time.sleep(0.2)
+                continue
+            return
+
+    def _poll_actor_state(self) -> None:
+        try:
+            info = self.core.gcs.call("GetActorInfo", actor_id=self.aid, timeout=5)
+        except Exception:
+            return
+        with self.core._actor_pending_lock:
+            mine = {
+                t: i
+                for t, i in self.core._pending_actor_tasks.items()
+                if i["aid"] == self.aid
+            }
+        if info is None or info["state"] == "DEAD":
+            cause = (info or {}).get("death_cause", "actor no longer exists")
+            for t, i in mine.items():
+                self.core._fail_actor_task(
+                    t, i["return_oids"],
+                    ActorDiedError(f"Actor {self.aid[:12]} died: {cause}"),
+                )
+            self._dead = True  # _loop retires on next wake
+            return
+        current = tuple(info["worker_addr"]) if info.get("worker_addr") else None
+        now = time.monotonic()
+        for t, i in mine.items():
+            # enqueued on an incarnation that is gone → the task was lost
+            if i["addr"] != current:
+                self.core._fail_actor_task(
+                    t, i["return_oids"],
+                    RayActorError(
+                        f"Actor {self.aid[:12]} restarted; task {t.hex()[:12]} was lost"
+                    ),
+                )
+            elif now - i.get("ts", now) > self._REQUERY_AGE_S:
+                # healthy actor, old pending task: the ActorTaskDone push may
+                # have been lost — ask the worker directly
+                self._requery(t, i, current)
+
+    def _requery(self, tid: TaskID, info: dict, addr: Tuple[str, int]) -> None:
+        try:
+            reply = get_client(addr).call(
+                "QueryActorTaskResult",
+                actor_id=self.aid,
+                task_id_bin=tid.binary(),
+                timeout=10,
+            )
+        except Exception:
+            return  # connection-level failures are the poll's job
+        status = reply.get("status")
+        if status == "done":
+            self.core._handle_actor_task_done(tid.binary(), reply["returns"])
+        elif status == "unknown":
+            self.core._fail_actor_task(
+                tid, info["return_oids"],
+                RayActorError(
+                    f"Actor {self.aid[:12]} has no record of task {tid.hex()[:12]}; it was lost"
+                ),
+            )
+        # "running": leave it pending
+
+
 class CoreWorker(CoreRuntime):
     def __init__(
         self,
@@ -104,6 +332,7 @@ class CoreWorker(CoreRuntime):
         self.server.register("GetObject", self._handle_get_object)
         self.server.register("WaitObject", self._handle_wait_object)
         self.server.register("RemoveBorrower", self._handle_remove_borrower)
+        self.server.register("ActorTaskDone", self._handle_actor_task_done)
         self.server.register("Ping", lambda: "pong")
         self.server.start(self.loop_thread)
         self.address: Tuple[str, int] = (self.server.host, self.server.port)
@@ -116,8 +345,10 @@ class CoreWorker(CoreRuntime):
         self._pending_tasks: Dict[TaskID, Dict[str, Any]] = {}
         # actor state
         self._actor_addr_cache: Dict[str, Tuple[Tuple[str, int], int]] = {}  # id -> (addr, version)
-        self._actor_seqno: Dict[str, int] = {}
-        self._actor_seq_lock = threading.Lock()
+        self._actor_dispatchers: Dict[str, _ActorDispatcher] = {}
+        self._actor_disp_lock = threading.Lock()
+        self._pending_actor_tasks: Dict[TaskID, Dict[str, Any]] = {}
+        self._actor_pending_lock = threading.Lock()
 
         # blocked-in-get tracking (CPU release protocol, see get())
         self._blocked_depth = 0
@@ -718,15 +949,11 @@ class CoreWorker(CoreRuntime):
         for oid in return_ids:
             self._ref_counter().add_owned_object(oid, pending_creation=True)
         ser_args, ser_kwargs = self._serialize_args(args, kwargs)
-        with self._actor_seq_lock:
-            seqno = self._actor_seqno.get(aid, 0)
-            self._actor_seqno[aid] = seqno + 1
         payload = {
             "actor_id": aid,
             "task_id": task_id.binary(),
             "method_name": method_name,
             "caller_id": self.worker_id_hex,
-            "seqno": seqno,
             "num_returns": opts.num_returns,
             "args": [
                 {
@@ -748,44 +975,53 @@ class CoreWorker(CoreRuntime):
             },
             "caller_addr": self.address,
         }
-
-        def _bg():
-            try:
-                addr = self._resolve_actor(aid)
-                client = get_client(addr)
-                reply = client.call("PushActorTask", payload=payload, timeout=-1)
-                for i, ret in enumerate(reply.get("returns", [])):
-                    oid = return_ids[i]
-                    if ret["kind"] == "inline":
-                        self.memory_store.put(oid, ("inline", ret["data"]))
-                    else:
-                        self.memory_store.put(oid, ("plasma", ret.get("node_id", self.node_id)))
-            except (RpcConnectionError, ConnectionError, OSError) as e:
-                # actor worker unreachable: report to GCS, mark unavailable
-                try:
-                    cached = self._actor_addr_cache.pop(aid, None)
-                    if cached:
-                        self.gcs.call_retrying(
-                            "ReportActorFault", actor_id=aid, worker_addr=cached[0], error=str(e)
-                        )
-                except Exception:
-                    pass
-                err = serialize(
-                    RayActorError(f"Actor {aid[:12]} became unreachable while executing {method_name}: {e}")
-                )
-                for oid in return_ids:
-                    self.memory_store.put(oid, ("inline", err))
-            except (ActorDiedError, ActorUnavailableError, RayActorError) as e:
-                err = serialize(e)
-                for oid in return_ids:
-                    self.memory_store.put(oid, ("inline", err))
-            except Exception as e:  # noqa: BLE001
-                err = serialize(RayActorError(f"actor call failed: {e!r}"))
-                for oid in return_ids:
-                    self.memory_store.put(oid, ("inline", err))
-
-        threading.Thread(target=_bg, daemon=True).start()
+        self._get_dispatcher(aid).submit(payload, return_ids)
         return [ObjectRef(oid, owner_addr=self.address) for oid in return_ids]
+
+    def _get_dispatcher(self, aid: str) -> _ActorDispatcher:
+        with self._actor_disp_lock:
+            disp = self._actor_dispatchers.get(aid)
+            if disp is None or not disp.thread.is_alive():
+                disp = _ActorDispatcher(self, aid)
+                self._actor_dispatchers[aid] = disp
+            return disp
+
+    def _handle_actor_task_done(self, task_id_bin: bytes, returns: List[dict]) -> dict:
+        """Execution result pushed back by the actor's worker."""
+        tid = TaskID(task_id_bin)
+        with self._actor_pending_lock:
+            info = self._pending_actor_tasks.pop(tid, None)
+        if info is None:
+            return {"ok": False}  # already failed (restart) — drop late result
+        for i, ret in enumerate(returns):
+            oid = info["return_oids"][i]
+            if ret["kind"] == "inline":
+                self.memory_store.put(oid, ("inline", ret["data"]))
+            else:
+                self.memory_store.put(oid, ("plasma", ret.get("node_id", self.node_id)))
+        return {"ok": True}
+
+    def _fail_actor_task(self, tid: TaskID, return_oids: List[ObjectID], err: Exception) -> None:
+        with self._actor_pending_lock:
+            self._pending_actor_tasks.pop(tid, None)
+        data = serialize(err)
+        for oid in return_oids:
+            if not self.memory_store.contains(oid):
+                self.memory_store.put(oid, ("inline", data))
+
+    def _report_actor_fault(self, aid: str, addr: Tuple[str, int], error: str) -> None:
+        self._invalidate_actor_addr(aid, addr)
+        try:
+            self.gcs.call_retrying(
+                "ReportActorFault", actor_id=aid, worker_addr=addr, error=error
+            )
+        except Exception:
+            pass
+
+    def _invalidate_actor_addr(self, aid: str, addr: Tuple[str, int]) -> None:
+        cached = self._actor_addr_cache.get(aid)
+        if cached is not None and cached[0] == addr:
+            self._actor_addr_cache.pop(aid, None)
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
         self._actor_addr_cache.pop(actor_id.hex(), None)
@@ -858,6 +1094,9 @@ class CoreWorker(CoreRuntime):
         if self._shutdown:
             return
         self._shutdown = True
+        with self._actor_disp_lock:
+            for d in self._actor_dispatchers.values():
+                d.stop()
         self.server.stop()
         try:
             self.plasma.close()

@@ -337,13 +337,17 @@ class GcsServer:
             if not n.alive:
                 continue
             if all(n.available_resources.get(k, 0.0) >= v for k, v in resources.items()):
-                candidates.append((len(self.actors), n.node_id))
+                # least-loaded first: fewest live actors already placed there
+                load = sum(1 for a in self.actors.values()
+                           if a.node_id == n.node_id and a.state != "DEAD")
+                candidates.append((load, n.node_id))
         if not candidates:
             # fall back: any node whose *total* resources fit (may queue)
             for n in self.nodes.values():
                 if n.alive and all(n.total_resources.get(k, 0.0) >= v for k, v in resources.items()):
                     return n.node_id
             return None
+        candidates.sort()
         return candidates[0][1]
 
     async def _schedule_actor(self, actor: ActorInfo) -> None:
@@ -569,10 +573,6 @@ class GcsServer:
         order = list(range(len(pg.bundles)))
         if pg.strategy in ("PACK", "STRICT_PACK"):
             node_ids = [n.node_id for n in alive]
-            # try to fit all on one node first
-            for nid in node_ids:
-                if all(fits(nid, b) or take(nid, b) for b in []):
-                    pass
             for idx in order:
                 b = pg.bundles[idx]
                 placed = False

@@ -153,13 +153,13 @@ class Raylet:
         # assigned per-lease via TPU_VISIBLE_CHIPS
         env.setdefault("JAX_PLATFORMS", "")
         log_path = os.path.join(self.session_dir, f"worker-{worker_id[:8]}.log")
-        logf = open(log_path, "ab")
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.workers.default_worker"],
-            env=env,
-            stdout=logf,
-            stderr=subprocess.STDOUT,
-        )
+        with open(log_path, "ab") as logf:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.workers.default_worker"],
+                env=env,
+                stdout=logf,
+                stderr=subprocess.STDOUT,
+            )
         handle = WorkerHandle(worker_id=worker_id, proc=proc)
         self.workers[worker_id] = handle
         return handle

@@ -17,9 +17,10 @@ from __future__ import annotations
 
 import logging
 import os
-import queue
 import threading
+import time
 import traceback
+from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -43,40 +44,46 @@ def _unpack_arg(a: dict) -> Any:
 
 
 class _ActorRunner:
-    """Hosts one actor instance: per-caller seqno ordering + concurrency pool."""
+    """Hosts one actor instance.
+
+    Arrival order IS per-caller submission order (the caller's
+    _ActorDispatcher sends one enqueue at a time), so the pool's FIFO
+    queue preserves ordering with no seqno windows; results are pushed
+    back to the owner asynchronously via its ActorTaskDone RPC
+    (reference: direct worker→owner reply path of PushTask,
+    core_worker.cc:3315).
+    """
+
+    _RESULT_CACHE_MAX = 256
+    _DELIVERY_ATTEMPTS = 4
 
     def __init__(self, actor_id: str, instance: Any, max_concurrency: int):
         self.actor_id = actor_id
         self.instance = instance
         self.max_concurrency = max(1, max_concurrency)
         self.pool = ThreadPoolExecutor(max_workers=self.max_concurrency, thread_name_prefix=f"actor-{actor_id[:8]}")
-        self.lock = threading.Lock()
-        self.next_seqno: Dict[str, int] = {}
-        self.buffered: Dict[str, Dict[int, Tuple[dict, "queue.Queue"]]] = {}
         self.dead = False
+        self.lock = threading.Lock()
+        self.inflight: set = set()  # task_id bins accepted but not finished
+        # completed results kept until delivery is confirmed (or LRU-evicted)
+        # so the caller's QueryActorTaskResult can recover a lost push
+        self.results: "OrderedDict[bytes, list]" = OrderedDict()
 
-    def submit(self, payload: dict, reply_q: "queue.Queue") -> None:
-        caller = payload["caller_id"]
-        seqno = payload["seqno"]
-        # pool.submit must happen under the lock: releasing it first lets a
-        # later seqno reach the executor queue before an earlier one
+    def submit(self, payload: dict) -> None:
         with self.lock:
-            expected = self.next_seqno.get(caller, 0)
-            if seqno != expected:
-                self.buffered.setdefault(caller, {})[seqno] = (payload, reply_q)
-                return
-            self.next_seqno[caller] = expected + 1
-            self.pool.submit(self._run, payload, reply_q)
-            while True:
-                nxt = self.next_seqno[caller]
-                entry = self.buffered.get(caller, {}).pop(nxt, None)
-                if entry is None:
-                    break
-                self.next_seqno[caller] = nxt + 1
-                self.pool.submit(self._run, entry[0], entry[1])
+            self.inflight.add(payload["task_id"])
+        self.pool.submit(self._run, payload)
 
-    def _run(self, payload: dict, reply_q: "queue.Queue") -> None:
-        reply_q.put(_execute_callable(
+    def query(self, task_id_bin: bytes) -> dict:
+        with self.lock:
+            if task_id_bin in self.results:
+                return {"status": "done", "returns": self.results.pop(task_id_bin)}
+            if task_id_bin in self.inflight:
+                return {"status": "running"}
+        return {"status": "unknown"}
+
+    def _run(self, payload: dict) -> None:
+        result = _execute_callable(
             lambda args, kwargs: getattr(self.instance, payload["method_name"])(*args, **kwargs),
             payload["args"],
             payload["kwargs"],
@@ -84,7 +91,36 @@ class _ActorRunner:
             TaskID(payload["task_id"]),
             payload["method_name"],
             actor_id=ActorID.from_hex(payload["actor_id"]),
-        ))
+        )
+        task_bin = payload["task_id"]
+        with self.lock:
+            self.inflight.discard(task_bin)
+            self.results[task_bin] = result["returns"]
+            while len(self.results) > self._RESULT_CACHE_MAX:
+                self.results.popitem(last=False)
+        caller_addr = tuple(payload["caller_addr"])
+        delay = 0.5
+        for attempt in range(self._DELIVERY_ATTEMPTS):
+            try:
+                get_client(caller_addr).call(
+                    "ActorTaskDone",
+                    task_id_bin=task_bin,
+                    returns=result["returns"],
+                    timeout=30,
+                )
+                with self.lock:
+                    self.results.pop(task_bin, None)
+                return
+            except Exception as e:  # noqa: BLE001
+                if attempt == self._DELIVERY_ATTEMPTS - 1:
+                    # leave the result cached; the caller's requery poll
+                    # will collect it if the caller is still alive
+                    logger.warning(
+                        "could not deliver actor task result to %s: %s", caller_addr, e
+                    )
+                else:
+                    time.sleep(delay)
+                    delay *= 2
 
 
 def _resolve_args(packed_args: List[dict], packed_kwargs: Dict[str, dict]) -> Tuple[tuple, dict]:
@@ -160,6 +196,7 @@ class WorkerServer:
         core.server.register("PushTask", self.PushTask)
         core.server.register("CreateActor", self.CreateActor)
         core.server.register("PushActorTask", self.PushActorTask)
+        core.server.register("QueryActorTaskResult", self.QueryActorTaskResult)
         core.server.register("KillActor", self.KillActor)
         core.server.register("SetLeaseContext", self.SetLeaseContext)
         core.server.register("Exit", self.Exit)
@@ -237,13 +274,19 @@ class WorkerServer:
         return {"ok": True}
 
     def PushActorTask(self, payload: dict) -> dict:
+        """Enqueue-and-ack: execution result goes back via ActorTaskDone."""
         runner = self.actors.get(payload["actor_id"])
         if runner is None or runner.dead:
-            err = serialize(RayActorError(f"Actor {payload['actor_id'][:12]} is not on this worker"))
-            return {"returns": [{"kind": "inline", "data": err} for _ in range(payload["num_returns"])]}
-        reply_q: "queue.Queue" = queue.Queue()
-        runner.submit(payload, reply_q)
-        return reply_q.get()
+            return {"accepted": False}
+        runner.submit(payload)
+        return {"accepted": True}
+
+    def QueryActorTaskResult(self, actor_id: str, task_id_bin: bytes) -> dict:
+        """Recovery path for a lost ActorTaskDone push."""
+        runner = self.actors.get(actor_id)
+        if runner is None:
+            return {"status": "unknown"}
+        return runner.query(task_id_bin)
 
     def KillActor(self, actor_id: str) -> dict:
         runner = self.actors.pop(actor_id, None)

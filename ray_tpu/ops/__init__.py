@@ -1,0 +1,17 @@
+"""ray_tpu.ops — TPU compute kernels (pallas + XLA) for the hot path."""
+
+from ray_tpu.ops.attention import (
+    blockwise_attention,
+    flash_attention,
+    gqa_expand,
+    mha_reference,
+)
+from ray_tpu.ops.ring_attention import ring_attention
+
+__all__ = [
+    "mha_reference",
+    "blockwise_attention",
+    "flash_attention",
+    "gqa_expand",
+    "ring_attention",
+]

@@ -1,0 +1,249 @@
+"""Attention ops, TPU-first.
+
+Three tiers, all the same math (softmax(QK^T * scale + mask) V):
+
+- `mha_reference`   : plain jnp, O(S^2) memory — ground truth for tests.
+- `blockwise_attention` : online-softmax over KV chunks via `lax.scan` —
+  O(S * block) memory, differentiable by autodiff, XLA-fusable. This is
+  the building block ring attention rotates (ops/ring_attention.py).
+- `flash_attention` : pallas TPU kernel for the forward hot path
+  (inference / benchmark); falls back to blockwise off-TPU. Gradients
+  flow through a custom_vjp whose backward recomputes blockwise.
+
+The reference framework has NO native attention (SURVEY.md §5
+"Long-context: absent in the reference" — it defers to vLLM/torch).
+Here it is a first-class op because the flagship models run *inside*
+this framework.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _scale(q, sm_scale):
+    return q * (sm_scale if sm_scale is not None else q.shape[-1] ** -0.5)
+
+
+def mha_reference(q, k, v, causal: bool = True, sm_scale: Optional[float] = None,
+                  q_offset: int = 0):
+    """Plain O(S^2) attention. Shapes: q [B, Sq, H, D], k/v [B, Sk, H, D].
+
+    `q_offset`: global position of q[0] relative to k[0] (used by ring
+    attention tests and decode).
+    """
+    q = _scale(q, sm_scale)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        qi = jnp.arange(sq)[:, None] + q_offset
+        ki = jnp.arange(sk)[None, :]
+        logits = jnp.where(qi >= ki, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+
+
+def _block_step(q, kc, vc, acc, m, l, mask=None):
+    """One online-softmax accumulation step.
+
+    q [B,Sq,H,D] fp32-scaled; kc/vc [B,Bk,H,D]; acc [B,Sq,H,D] fp32;
+    m,l [B,H,Sq] fp32 running max / normalizer. Returns updated (acc,m,l).
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, kc, preferred_element_type=jnp.float32)
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    p = jnp.exp(s - m_new[..., None])
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(vc.dtype), vc).astype(jnp.float32)
+    acc_new = acc * alpha.transpose(0, 2, 1)[..., None] + pv
+    return acc_new, m_new, l_new
+
+
+def blockwise_attention(q, k, v, causal: bool = True,
+                        sm_scale: Optional[float] = None,
+                        block_k: int = 512, q_offset: int = 0):
+    """Memory-efficient attention: scan over KV chunks with online softmax.
+
+    Never materializes the [Sq, Sk] matrix; autodiff through the scan
+    gives a memory-efficient backward for free (combine with
+    `jax.checkpoint` at the layer level for long sequences).
+    """
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_k = min(block_k, sk)
+    nblocks = (sk + block_k - 1) // block_k
+    pad = nblocks * block_k - sk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+
+    qs = _scale(q, sm_scale).astype(jnp.float32)
+    kb = k.reshape(b, nblocks, block_k, h, d).transpose(1, 0, 2, 3, 4)
+    vb = v.reshape(b, nblocks, block_k, h, d).transpose(1, 0, 2, 3, 4)
+
+    qi = jnp.arange(sq)[:, None] + q_offset  # global q positions
+
+    def step(carry, inp):
+        acc, m, l = carry
+        blk_idx, kc, vc = inp
+        ki = blk_idx * block_k + jnp.arange(block_k)[None, :]
+        valid = ki < sk
+        msk = valid if not causal else (qi >= ki) & valid
+        msk = msk[None, None]  # [1,1,Sq,Bk]
+        acc, m, l = _block_step(qs, kc, vc, acc, m, l, mask=msk)
+        return (acc, m, l), None
+
+    init = (
+        jnp.zeros((b, sq, h, d), jnp.float32),
+        jnp.full((b, h, sq), NEG_INF, jnp.float32),
+        jnp.zeros((b, h, sq), jnp.float32),
+    )
+    (acc, m, l), _ = lax.scan(step, init, (jnp.arange(nblocks), kb, vb))
+    out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward kernel (TPU): one (batch*head, q-block) program per grid
+# cell, inner fori_loop over k blocks with online softmax in VMEM.
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, block_k, causal,
+                      seq_k):
+    import jax.experimental.pallas as pl
+
+    block_q, d = q_ref.shape
+    qi_base = pl.program_id(1) * block_q
+    q = q_ref[:].astype(jnp.float32) * sm_scale
+
+    nk = pl.cdiv(seq_k, block_k)
+    if causal:
+        # skip k blocks entirely above the diagonal
+        nk = pl.cdiv(jnp.minimum(qi_base + block_q, seq_k), block_k)
+
+    def body(i, carry):
+        acc, m, l = carry
+        kc = k_ref[pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        vc = v_ref[pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, kc.T, preferred_element_type=jnp.float32)
+        ki = i * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        qidx = qi_base + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        msk = ki < seq_k
+        if causal:
+            msk = msk & (qidx >= ki)
+        s = jnp.where(msk, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.dot(p, vc, preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    init = (
+        jnp.zeros((block_q, d), jnp.float32),
+        jnp.full((block_q, 1), NEG_INF, jnp.float32),
+        jnp.zeros((block_q, 1), jnp.float32),
+    )
+    acc, m, l = lax.fori_loop(0, nk, body, init)
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    pad_q = (-sq) % block_q
+    pad_k = (-sk) % block_k
+    qp = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0))) if pad_q else q
+    kp = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0), (0, 0))) if pad_k else k
+    vp = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0), (0, 0))) if pad_k else v
+
+    # [B,S,H,D] -> [B*H, S, D] programs
+    qf = qp.transpose(0, 2, 1, 3).reshape(b * h, sq + pad_q, d)
+    kf = kp.transpose(0, 2, 1, 3).reshape(b * h, sk + pad_k, d)
+    vf = vp.transpose(0, 2, 1, 3).reshape(b * h, sk + pad_k, d)
+
+    grid = (b * h, (sq + pad_q) // block_q)
+    kernel = functools.partial(
+        _flash_fwd_kernel, sm_scale=scale, block_k=block_k, causal=causal,
+        seq_k=sk,
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, sk + pad_k, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, sk + pad_k, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+    )(qf, kf, vf)
+    out = out.reshape(b, h, sq + pad_q, d).transpose(0, 2, 1, 3)
+    return out[:, :sq]
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = 256, block_k: int = 512):
+    """Fused attention. Pallas kernel forward on TPU; blockwise-scan
+    forward elsewhere; blockwise backward everywhere (recompute, no
+    O(S^2) residuals)."""
+    return _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)[0]
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    if _on_tpu():
+        out = _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k)
+    else:
+        out = blockwise_attention(q, k, v, causal, sm_scale, block_k)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal, sm_scale, block_k),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def gqa_expand(k, v, num_q_heads: int):
+    """Expand grouped KV heads to match q heads (GQA → MHA view).
+
+    [B,S,Hkv,D] → [B,S,Hq,D] by repeat; XLA turns this into a broadcast,
+    no copy on TPU when fused into the attention einsum.
+    """
+    hkv = k.shape[2]
+    if hkv == num_q_heads:
+        return k, v
+    rep = num_q_heads // hkv
+    k = jnp.repeat(k, rep, axis=2)
+    v = jnp.repeat(v, rep, axis=2)
+    return k, v

@@ -1,0 +1,91 @@
+"""Ring attention — exact attention over sequence shards via ppermute.
+
+Sequence/context parallelism is ABSENT in the reference (SURVEY.md §5,
+grep-verified); here it is first-class: shard the sequence axis over the
+`"sequence"` mesh axis, keep Q local, and rotate KV blocks around the
+ring with `lax.ppermute` while accumulating online softmax — exact
+attention with O(S/n) memory per chip and comms overlapping compute on
+ICI (the pattern from Liu et al.'s Ring Attention, built on the
+blockwise kernel in ops/attention.py).
+
+Usage (inside shard_map with sequence sharded over `axis_name`):
+
+    out = ring_attention(q, k, v, axis_name="sequence")
+
+Autodiff works through the scan+ppermute, so the same code path trains.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.ops.attention import NEG_INF, _block_step, _scale
+
+
+def ring_attention(q, k, v, axis_name: str = "sequence", causal: bool = True,
+                   sm_scale: Optional[float] = None):
+    """Exact attention with q/k/v sequence-sharded over `axis_name`.
+
+    Shapes per device: q [B, Sq_local, H, D], k/v [B, Sk_local, H, D].
+    Shards are assumed contiguous in ring order: device i holds global
+    positions [i*S_local, (i+1)*S_local).
+    """
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        from ray_tpu.ops.attention import blockwise_attention
+
+        return blockwise_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+
+    my = lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    qs = _scale(q, sm_scale).astype(jnp.float32)
+    q_pos = my * sq + jnp.arange(sq)[:, None]  # [Sq,1] global q positions
+
+    # Rotate kv "backwards" so earlier (lower-offset) blocks arrive first;
+    # perm: each device sends its kv to the next-higher rank.
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def _accumulate(kv, acc, m, l, t):
+        kc, vc = kv
+        src = (my - t) % n  # rank whose kv we hold this step
+        k_pos = src * sk + jnp.arange(sk)[None, :]
+        msk = None
+        if causal:
+            msk = (q_pos >= k_pos)[None, None]  # [1,1,Sq,Sk]
+        return _block_step(qs, kc, vc, acc, m, l, mask=msk)
+
+    def step(carry, t):
+        kv, acc, m, l = carry
+        acc, m, l = _accumulate(kv, acc, m, l, t)
+        kv = jax.tree.map(lambda x: lax.ppermute(x, axis_name, perm), kv)
+        return (kv, acc, m, l), None
+
+    # Mark accumulators device-varying so the scan carry type matches the
+    # output (the mask depends on axis_index → varying).
+    def _vary(x):
+        try:
+            return lax.pcast(x, (axis_name,), to="varying")
+        except Exception:
+            try:
+                return lax.pvary(x, (axis_name,))
+            except Exception:
+                return x
+
+    init = (
+        (k, v),
+        _vary(jnp.zeros((b, sq, h, d), jnp.float32)),
+        _vary(jnp.full((b, h, sq), NEG_INF, jnp.float32)),
+        _vary(jnp.zeros((b, h, sq), jnp.float32)),
+    )
+    # Scan the first n-1 steps (each ends by rotating kv); do the final
+    # accumulation outside the scan so the last rotation — whose result
+    # would be dead — is never sent over ICI.
+    (kv, acc, m, l), _ = lax.scan(step, init, jnp.arange(n - 1))
+    acc, m, l = _accumulate(kv, acc, m, l, n - 1)
+    out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)

@@ -41,6 +41,7 @@
 #include <time.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <cstring>
 #include <deque>
 #include <list>
@@ -177,7 +178,6 @@ struct Client {
   int fd;
   std::string inbuf;
   std::string outbuf;
-  std::vector<std::shared_ptr<PendingGet>> pending;
   std::unordered_map<ObjectId, int, IdHash> pins;  // per-client refcounts
 };
 
@@ -387,7 +387,9 @@ class Server {
       if (oit->second.in_lru) store_->lru_.erase(oit->second.lru_it);
       store_->objects_.erase(oit);
     }
-    for (auto& pg : c.pending) pg->done = true;
+    for (auto& pg : store_->waiting_gets_)
+      if (pg->client_fd == fd) pg->done = true;
+    Compact();
     epoll_ctl(epfd_, EPOLL_CTL_DEL, fd, nullptr);
     close(fd);
     clients_.erase(it);
@@ -516,7 +518,6 @@ class Server {
         } else if (timeout_ms == 0) {
           ReplyGet(c, *pg, true);  // immediate, TIMEOUT for unsealed
         } else {
-          c.pending.push_back(pg);
           store_->waiting_gets_.push_back(pg);
         }
         break;
@@ -670,8 +671,12 @@ class Server {
   }
 
   void Compact() {
-    while (!store_->waiting_gets_.empty() && store_->waiting_gets_.front()->done)
-      store_->waiting_gets_.pop_front();
+    // erase done entries anywhere in the deque: one stuck no-timeout get at
+    // the front must not pin every later completed entry
+    auto& wg = store_->waiting_gets_;
+    wg.erase(std::remove_if(wg.begin(), wg.end(),
+                            [](const std::shared_ptr<PendingGet>& pg) { return pg->done; }),
+             wg.end());
   }
 
   int NextTimeoutMs() {

@@ -8,10 +8,17 @@ dry-run-compiles the multi-chip path via __graft_entry__.dryrun_multichip).
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The axon sitecustomize registers the TPU-tunnel backend and forces
+# jax_platforms="axon,cpu" at import time; override back to CPU so tests
+# run on the virtual 8-device mesh regardless of import order.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 # Let local-mode tests pretend the host has 4 TPU chips for resource math.
 os.environ.setdefault("RAY_TPU_FAKE_CHIPS", "4")
 

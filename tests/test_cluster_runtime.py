@@ -150,3 +150,46 @@ def test_nested_task_submission(cluster):
 def test_cluster_resources_visible(cluster):
     res = ray_tpu.cluster_resources()
     assert res["CPU"] == 3.0
+
+
+def test_actor_restart_after_worker_death(cluster):
+    """Regression: calls made after an actor restart must reach the new
+    incarnation (the old seqno-window protocol hung forever here)."""
+
+    @ray_tpu.remote(max_restarts=2)
+    class Phoenix:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+        def die(self):
+            import os
+
+            os._exit(1)
+
+    p = Phoenix.remote()
+    assert ray_tpu.get(p.incr.remote(), timeout=60) == 1
+    old_pid = ray_tpu.get(p.pid.remote(), timeout=60)
+    try:
+        ray_tpu.get(p.die.remote(), timeout=30)
+    except Exception:
+        pass  # in-flight task may fail with RayActorError — expected
+    # post-restart calls must succeed on a fresh incarnation (state reset)
+    deadline = time.monotonic() + 60
+    val = None
+    while time.monotonic() < deadline:
+        try:
+            val = ray_tpu.get(p.incr.remote(), timeout=30)
+            break
+        except ray_tpu.exceptions.RayActorError:
+            time.sleep(0.5)  # restart in progress; lost-task failures OK
+    assert val == 1, f"expected fresh state after restart, got {val}"
+    assert ray_tpu.get(p.pid.remote(), timeout=30) != old_pid
