@@ -331,6 +331,7 @@ class CoreWorker(CoreRuntime):
         self.server = RpcServer(name=f"core-{self.worker_id_hex[:8]}")
         self.server.register("GetObject", self._handle_get_object)
         self.server.register("WaitObject", self._handle_wait_object)
+        self.server.register("AddBorrower", self._handle_add_borrower)
         self.server.register("RemoveBorrower", self._handle_remove_borrower)
         self.server.register("ActorTaskDone", self._handle_actor_task_done)
         self.server.register("Ping", lambda: "pong")
@@ -348,11 +349,24 @@ class CoreWorker(CoreRuntime):
         self._actor_dispatchers: Dict[str, _ActorDispatcher] = {}
         self._actor_disp_lock = threading.Lock()
         self._pending_actor_tasks: Dict[TaskID, Dict[str, Any]] = {}
+        self._actor_task_contained: Dict[TaskID, List[ObjectID]] = {}
         self._actor_pending_lock = threading.Lock()
 
         # blocked-in-get tracking (CPU release protocol, see get())
         self._blocked_depth = 0
         self._blocked_lock = threading.Lock()
+
+        # borrowed-ref registry: oid -> owner addr this process registered with
+        self._borrow_registered: Dict[ObjectID, Tuple[str, int]] = {}
+        # owned put-objects whose payload contains nested refs (pinned)
+        self._put_contained: Dict[ObjectID, List[ObjectID]] = {}
+        self._borrow_lock = threading.Lock()
+        from concurrent.futures import ThreadPoolExecutor as _TPE
+
+        self._borrow_release_pool = _TPE(max_workers=1, thread_name_prefix="borrow-release")
+        w = worker_mod.global_worker
+        if w is not None:
+            w.reference_counter.set_borrow_release_callback(self._on_borrow_released)
 
         self._shutdown = False
 
@@ -362,15 +376,22 @@ class CoreWorker(CoreRuntime):
     def _handle_get_object(self, object_id_bin: bytes) -> dict:
         oid = ObjectID(object_id_bin)
         e = self.memory_store.get_if_exists(oid)
-        if e is None:
+        if e is not None:
+            kind = e.value[0]
+            if kind == "inline":
+                return {"status": "inline", "data": e.value[1]}
+            return {"status": "plasma", "node_id": e.value[1]}
+        # distinguish "not created yet" from "owner already freed it" so
+        # borrowers get ObjectLostError instead of waiting forever
+        if self._ref_counter().has_reference(oid):
             return {"status": "pending"}
-        kind = e.value[0]
-        if kind == "inline":
-            return {"status": "inline", "data": e.value[1]}
-        return {"status": "plasma", "node_id": e.value[1]}
+        return {"status": "freed"}
 
     def _handle_wait_object(self, object_id_bin: bytes, timeout_s: float = 10.0) -> dict:
         oid = ObjectID(object_id_bin)
+        state = self._handle_get_object(object_id_bin)
+        if state["status"] != "pending":
+            return state
         f = self.memory_store.as_future(oid)
         try:
             f.result(timeout=timeout_s)
@@ -378,11 +399,58 @@ class CoreWorker(CoreRuntime):
             pass
         return self._handle_get_object(object_id_bin)
 
+    def _handle_add_borrower(self, object_id_bin: bytes, borrower: Tuple[str, int]) -> dict:
+        oid = ObjectID(object_id_bin)
+        # add_borrower is atomic: it refuses to resurrect an entry for an
+        # already-freed object (the borrower then gets status "freed")
+        if self._ref_counter().add_borrower(oid, tuple(borrower)):
+            return {"ok": True}
+        return {"ok": False, "freed": True}
+
     def _handle_remove_borrower(self, object_id_bin: bytes, borrower: Tuple[str, int]) -> dict:
         w = worker_mod.global_worker
         if w is not None:
             w.reference_counter.remove_borrower(ObjectID(object_id_bin), tuple(borrower))
         return {"ok": True}
+
+    # -- borrower side (this process holds refs it does not own) --------
+    def on_ref_created(self, oid: ObjectID, owner_addr: Tuple[str, int]) -> None:
+        """Called by ObjectRef.__init__ for refs carrying an owner address.
+        First sighting of a borrowed oid → synchronously register with the
+        owner (synchronous so the sender's pin is still alive — closing
+        the free-before-borrow race)."""
+        if owner_addr == self.address or self._ref_counter().is_owned(oid):
+            return
+        with self._borrow_lock:
+            if oid in self._borrow_registered:
+                return
+            self._borrow_registered[oid] = owner_addr
+        try:
+            get_client(owner_addr).call(
+                "AddBorrower", object_id_bin=oid.binary(), borrower=self.address,
+                timeout=10,
+            )
+        except Exception:
+            pass  # owner gone: get() will surface ObjectLostError
+
+    def _on_borrow_released(self, oid: ObjectID) -> None:
+        with self._borrow_lock:
+            owner = self._borrow_registered.pop(oid, None)
+        if owner is None:
+            return
+
+        # network send off-thread: this is called from ObjectRef.__del__
+        # paths where a dead owner's connect timeout must not stall the
+        # releasing thread
+        def _send():
+            try:
+                get_client(owner).call_oneway(
+                    "RemoveBorrower", object_id_bin=oid.binary(), borrower=self.address
+                )
+            except Exception:
+                pass
+
+        self._borrow_release_pool.submit(_send)
 
     # ==================================================================
     # Objects
@@ -393,8 +461,21 @@ class CoreWorker(CoreRuntime):
     def put(self, value: Any) -> ObjectRef:
         w = worker_mod.global_worker
         oid = ObjectID.from_index(w.current_task_id, w.next_put_index())
-        self.put_serialized(oid, serialize(value))
-        self._ref_counter().add_owned_object(oid)
+        from ray_tpu._private.serialization import collect_object_refs
+
+        with collect_object_refs() as col:
+            data = serialize(value)
+        self.put_serialized(oid, data)
+        rc = self._ref_counter()
+        rc.add_owned_object(oid)
+        if col.refs:
+            # pin refs nested inside the stored value for the outer
+            # object's lifetime; released when the outer object is freed
+            inner = [r.id() for r in col.refs]
+            for i in inner:
+                rc.add_submitted_task_ref(i)
+            with self._borrow_lock:
+                self._put_contained[oid] = inner
         return ObjectRef(oid, owner_addr=self.address)
 
     def put_serialized(self, oid: ObjectID, data: bytes) -> None:
@@ -474,6 +555,11 @@ class CoreWorker(CoreRuntime):
                 return val
             if reply["status"] == "plasma":
                 return self._deserialize_entry(oid, ("plasma", reply["node_id"]))
+            if reply["status"] == "freed":
+                raise ObjectLostError(
+                    f"object {oid.hex()} was already freed by its owner "
+                    "(all references released before this read)"
+                )
             if deadline is not None and time.monotonic() > deadline:
                 raise GetTimeoutError(f"Get timed out for {oid.hex()}")
 
@@ -568,6 +654,10 @@ class CoreWorker(CoreRuntime):
         return out
 
     def free_object(self, oid: ObjectID) -> None:
+        with self._borrow_lock:
+            inner = self._put_contained.pop(oid, None)
+        if inner:
+            self._release_contained_refs(inner)
         e = self.memory_store.get_if_exists(oid)
         self.memory_store.delete(oid)
         with self._pin_lock:
@@ -587,16 +677,28 @@ class CoreWorker(CoreRuntime):
     # Task submission (reference: normal_task_submitter.cc SubmitTask /
     # OnWorkerIdle / RequestNewWorkerIfNeeded)
     # ==================================================================
-    def _serialize_args(self, args: tuple, kwargs: dict) -> Tuple[List[TaskArg], List[TaskArg]]:
+    def _serialize_args(
+        self, args: tuple, kwargs: dict
+    ) -> Tuple[List[TaskArg], Dict[str, TaskArg], List[ObjectID]]:
+        """Returns (args, kwargs, contained_oids). Both direct ref args and
+        refs NESTED inside pickled values are pinned (submitted-task refs,
+        reference_counter.h:44) until the task completes; contained_oids
+        lists the nested ones so completion can unpin them."""
         out_args: List[TaskArg] = []
-        out_kwargs: Dict[str, TaskArg] = {}
+        contained: List[ObjectID] = []
 
         def conv(v) -> TaskArg:
             if isinstance(v, ObjectRef):
                 self._ref_counter().add_submitted_task_ref(v.id())
                 owner = v.owner_address or self.address
                 return TaskArg(is_ref=True, object_id=v.id(), owner_addr=tuple(owner))
-            data = serialize(v)
+            from ray_tpu._private.serialization import collect_object_refs
+
+            with collect_object_refs() as col:
+                data = serialize(v)
+            for r in col.refs:
+                self._ref_counter().add_submitted_task_ref(r.id())
+                contained.append(r.id())
             if len(data) > config.object_store_inline_max_bytes:
                 # promote big arg to an owned shared-memory object
                 w = worker_mod.global_worker
@@ -610,12 +712,29 @@ class CoreWorker(CoreRuntime):
         for a in args:
             out_args.append(conv(a))
         kw = {k: conv(v) for k, v in kwargs.items()}
-        return out_args, kw
+        return out_args, kw, contained
+
+    def _release_contained_refs(self, oids: List[ObjectID]) -> None:
+        rc = self._ref_counter()
+        for oid in oids:
+            rc.remove_submitted_task_ref(oid)
+
+    def _release_task_refs(self, spec: TaskSpec) -> None:
+        """Release every pin a normal-task submission took (direct ref
+        args + nested refs). Idempotent — completion and the several
+        failure paths may both reach it."""
+        if getattr(spec, "_refs_released", False):
+            return
+        spec._refs_released = True  # type: ignore[attr-defined]
+        for a in spec.args + list(getattr(spec, "kwargs_map", {}).values()):
+            if a.is_ref and a.object_id is not None:
+                self._ref_counter().remove_submitted_task_ref(a.object_id)
+        self._release_contained_refs(getattr(spec, "contained_refs", []))
 
     def submit_task(self, remote_function, args, kwargs, opts: TaskOptions) -> List[ObjectRef]:
         w = worker_mod.global_worker
         task_id = TaskID.for_normal_task(self.job_id)
-        ser_args, ser_kwargs = self._serialize_args(args, kwargs)
+        ser_args, ser_kwargs, contained = self._serialize_args(args, kwargs)
         from ray_tpu._private.serialization import dumps_function
 
         spec = TaskSpec(
@@ -634,6 +753,7 @@ class CoreWorker(CoreRuntime):
             runtime_env=opts.runtime_env,
         )
         spec.kwargs_map = ser_kwargs  # type: ignore[attr-defined]
+        spec.contained_refs = contained  # type: ignore[attr-defined]
         return_ids = spec.return_ids()
         for oid in return_ids:
             self._ref_counter().add_owned_object(oid, pending_creation=True)
@@ -717,6 +837,7 @@ class CoreWorker(CoreRuntime):
         for s in specs:
             for oid in s.return_ids():
                 self.memory_store.put(oid, ("inline", data))
+            self._release_task_refs(s)
             self._pending_tasks.pop(s.task_id, None)
 
     async def _on_lease_idle(self, sc, entry: _LeaseEntry) -> None:
@@ -756,6 +877,7 @@ class CoreWorker(CoreRuntime):
             data = serialize(err)
             for oid in spec.return_ids():
                 self.memory_store.put(oid, ("inline", data))
+            self._release_task_refs(spec)
             self._pending_tasks.pop(spec.task_id, None)
             entry.busy = False
             await self._on_lease_idle(spec.scheduling_class, entry)
@@ -840,6 +962,7 @@ class CoreWorker(CoreRuntime):
             data = serialize(err)
             for oid in spec.return_ids():
                 self.memory_store.put(oid, ("inline", data))
+            self._release_task_refs(spec)
             self._pending_tasks.pop(spec.task_id, None)
 
     def _complete_task(self, spec: TaskSpec, reply: dict) -> None:
@@ -858,10 +981,7 @@ class CoreWorker(CoreRuntime):
                 self.memory_store.put(oid, ("inline", ret["data"]))
             else:
                 self.memory_store.put(oid, ("plasma", ret.get("node_id", self.node_id)))
-        # release submitted-task arg refs
-        for a in spec.args + list(getattr(spec, "kwargs_map", {}).values()):
-            if a.is_ref and a.object_id is not None:
-                self._ref_counter().remove_submitted_task_ref(a.object_id)
+        self._release_task_refs(spec)
         self._pending_tasks.pop(spec.task_id, None)
 
     # ==================================================================
@@ -870,7 +990,9 @@ class CoreWorker(CoreRuntime):
     # ==================================================================
     def create_actor(self, actor_class, args, kwargs, opts: ActorOptions) -> ActorID:
         actor_id = ActorID.of(self.job_id)
-        ser_args, ser_kwargs = self._serialize_args(args, kwargs)
+        # contained/direct arg refs stay pinned for the actor's lifetime:
+        # restarts replay __init__ from the same spec (gcs_actor_manager.cc:1721)
+        ser_args, ser_kwargs, _ = self._serialize_args(args, kwargs)
         from ray_tpu._private.serialization import dumps_function
 
         spec_payload = {
@@ -948,7 +1070,16 @@ class CoreWorker(CoreRuntime):
         return_ids = [ObjectID.from_index(task_id, i + 1) for i in range(opts.num_returns)]
         for oid in return_ids:
             self._ref_counter().add_owned_object(oid, pending_creation=True)
-        ser_args, ser_kwargs = self._serialize_args(args, kwargs)
+        ser_args, ser_kwargs, contained = self._serialize_args(args, kwargs)
+        # every pin taken for this task (direct ref args + promoted big
+        # args + nested refs) — released exactly once on done/fail
+        pinned = list(contained)
+        for a in list(ser_args) + list(ser_kwargs.values()):
+            if a.is_ref and a.object_id is not None:
+                pinned.append(a.object_id)
+        if pinned:
+            with self._actor_pending_lock:
+                self._actor_task_contained[task_id] = pinned
         payload = {
             "actor_id": aid,
             "task_id": task_id.binary(),
@@ -991,6 +1122,8 @@ class CoreWorker(CoreRuntime):
         tid = TaskID(task_id_bin)
         with self._actor_pending_lock:
             info = self._pending_actor_tasks.pop(tid, None)
+            contained = self._actor_task_contained.pop(tid, [])
+        self._release_contained_refs(contained)
         if info is None:
             return {"ok": False}  # already failed (restart) — drop late result
         for i, ret in enumerate(returns):
@@ -1004,6 +1137,8 @@ class CoreWorker(CoreRuntime):
     def _fail_actor_task(self, tid: TaskID, return_oids: List[ObjectID], err: Exception) -> None:
         with self._actor_pending_lock:
             self._pending_actor_tasks.pop(tid, None)
+            contained = self._actor_task_contained.pop(tid, [])
+        self._release_contained_refs(contained)
         data = serialize(err)
         for oid in return_oids:
             if not self.memory_store.contains(oid):

@@ -32,6 +32,13 @@ class ObjectRef:
         w = _worker_mod.global_worker
         if w is not None and w.connected:
             w.reference_counter.add_local_reference(self._id)
+            # Borrowed ref (constructed from a deserialized payload in a
+            # process that doesn't own it): register with the owner so it
+            # keeps the object alive (reference_counter.h:44 borrowers).
+            if owner_addr is not None:
+                core = getattr(w, "core", None)
+                if core is not None and hasattr(core, "on_ref_created"):
+                    core.on_ref_created(self._id, tuple(owner_addr))
 
     # -- identity ---------------------------------------------------------
     def id(self) -> ObjectID:

@@ -24,7 +24,10 @@ from ray_tpu._private.ids import ObjectID
 class _Ref:
     local_refs: int = 0
     submitted_task_refs: int = 0
-    borrowers: Set[Tuple[str, int]] = field(default_factory=set)
+    # owner side: borrower core-worker address -> number of outstanding
+    # borrow registrations from that process (a borrower deregisters all
+    # of them at once when its last local ref dies)
+    borrowers: Dict[Tuple[str, int], int] = field(default_factory=dict)
     owned: bool = False
     lineage_pinned: bool = False
     pending_creation: bool = False
@@ -36,10 +39,16 @@ class ReferenceCounter:
         self._refs: Dict[ObjectID, _Ref] = {}
         # called when an *owned* object's global count hits zero
         self._on_zero: Optional[Callable[[ObjectID], None]] = None
+        # called when a *borrowed* (non-owned) ref's local count hits zero
+        # (the core worker deregisters with the owner)
+        self._on_borrow_released: Optional[Callable[[ObjectID], None]] = None
         self._frozen = False
 
     def set_on_zero_callback(self, cb: Callable[[ObjectID], None]) -> None:
         self._on_zero = cb
+
+    def set_borrow_release_callback(self, cb: Callable[[ObjectID], None]) -> None:
+        self._on_borrow_released = cb
 
     def freeze(self) -> None:
         """Stop issuing on-zero callbacks (during shutdown)."""
@@ -74,7 +83,8 @@ class ReferenceCounter:
             if r is None:
                 return
             r.local_refs -= 1
-            self._maybe_release(oid, r)
+            action = self._maybe_release(oid, r)
+        self._run_release_action(action, oid)
 
     def add_submitted_task_ref(self, oid: ObjectID) -> None:
         with self._lock:
@@ -86,32 +96,56 @@ class ReferenceCounter:
             if r is None:
                 return
             r.submitted_task_refs -= 1
-            self._maybe_release(oid, r)
+            action = self._maybe_release(oid, r)
+        self._run_release_action(action, oid)
 
-    # -- borrowers (installed by cluster runtime) -------------------------
-    def add_borrower(self, oid: ObjectID, borrower_addr: Tuple[str, int]) -> None:
+    # -- borrowers (owner side; reference: reference_counter.h:44 borrower
+    # bookkeeping — a borrower process registers before it may read, the
+    # owner keeps the object alive until every borrower deregisters) -----
+    def add_borrower(self, oid: ObjectID, borrower_addr: Tuple[str, int]) -> bool:
+        """Owner side. Returns False (no entry created) when the object's
+        ref entry is already gone — i.e. the object was freed; recreating
+        a zombie entry would make readers see 'pending' forever."""
         with self._lock:
-            self._refs.setdefault(oid, _Ref()).borrowers.add(borrower_addr)
+            r = self._refs.get(oid)
+            if r is None:
+                return False
+            r.borrowers[borrower_addr] = r.borrowers.get(borrower_addr, 0) + 1
+            return True
 
     def remove_borrower(self, oid: ObjectID, borrower_addr: Tuple[str, int]) -> None:
         with self._lock:
             r = self._refs.get(oid)
             if r is None:
                 return
-            r.borrowers.discard(borrower_addr)
-            self._maybe_release(oid, r)
+            r.borrowers.pop(borrower_addr, None)
+            action = self._maybe_release(oid, r)
+        self._run_release_action(action, oid)
 
     # -- internal ---------------------------------------------------------
-    def _maybe_release(self, oid: ObjectID, r: _Ref) -> None:
+    def _maybe_release(self, oid: ObjectID, r: _Ref) -> Optional[Callable]:
+        """Must be called with the lock held; returns the release callback
+        to invoke AFTER dropping the lock (callbacks do store/network IO —
+        running them under the lock would stall every ref-count op)."""
         if r.local_refs <= 0 and r.submitted_task_refs <= 0 and not r.borrowers:
             owned = r.owned
             pinned = r.lineage_pinned
             del self._refs[oid]
-            if owned and not pinned and self._on_zero and not self._frozen:
-                try:
-                    self._on_zero(oid)
-                except Exception:
-                    pass
+            if self._frozen:
+                return None
+            if owned and not pinned:
+                return self._on_zero
+            if not owned:
+                return self._on_borrow_released
+        return None
+
+    @staticmethod
+    def _run_release_action(action: Optional[Callable], oid: ObjectID) -> None:
+        if action is not None:
+            try:
+                action(oid)
+            except Exception:
+                pass
 
     def num_tracked(self) -> int:
         with self._lock:

@@ -23,6 +23,25 @@ import cloudpickle
 _custom_serializers: Dict[type, Tuple[Callable, Callable]] = {}
 _lock = threading.Lock()
 
+# Thread-local collector: while active, every ObjectRef pickled through
+# serialize() is recorded so callers can pin/borrow-register contained
+# refs (reference: nested-ref tracking in reference_counter.h:44).
+_collect_ctx = threading.local()
+
+
+class collect_object_refs:
+    """Context manager; exposes `.refs` — the list of ObjectRefs that were
+    serialized (nested at any depth) while active on this thread."""
+
+    def __enter__(self):
+        self._prev = getattr(_collect_ctx, "refs", None)
+        _collect_ctx.refs = self.refs = []
+        return self
+
+    def __exit__(self, *exc):
+        _collect_ctx.refs = self._prev
+        return False
+
 
 def register_serializer(cls: type, *, serializer: Callable, deserializer: Callable) -> None:
     """Register a custom (de)serializer pair (reference:
@@ -41,11 +60,21 @@ class _CustomPickler(cloudpickle.Pickler):
         super().__init__(file, protocol=protocol, buffer_callback=buffer_callback)
 
     def reducer_override(self, obj):
+        from ray_tpu._private.object_ref import ObjectRef
+
+        if isinstance(obj, ObjectRef):
+            refs = getattr(_collect_ctx, "refs", None)
+            if refs is not None:
+                refs.append(obj)
+            return obj.__reduce__()
         s = _custom_serializers.get(type(obj))
         if s is not None:
             ser, deser = s
             return (_reconstruct_custom, (type(obj).__module__, type(obj).__qualname__, ser(obj)))
-        return NotImplemented
+        # Delegate to cloudpickle's reducer_override — that is where its
+        # pickle-functions/classes-by-value logic lives; returning
+        # NotImplemented here would silently downgrade to plain pickle.
+        return super().reducer_override(obj)
 
 
 def _reconstruct_custom(module: str, qualname: str, payload: Any):

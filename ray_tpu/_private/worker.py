@@ -126,6 +126,8 @@ def init(
             )
             w.job_id = w.core.job_id
         w.reference_counter.set_on_zero_callback(w.core.free_object)
+        if hasattr(w.core, "_on_borrow_released"):
+            w.reference_counter.set_borrow_release_callback(w.core._on_borrow_released)
         global_worker = w
         atexit.register(_atexit_shutdown)
         return {

@@ -91,6 +91,7 @@ class _ActorRunner:
             TaskID(payload["task_id"]),
             payload["method_name"],
             actor_id=ActorID.from_hex(payload["actor_id"]),
+            caller_addr=tuple(payload["caller_addr"]),
         )
         task_bin = payload["task_id"]
         with self.lock:
@@ -147,8 +148,11 @@ def _execute_callable(
     task_id: TaskID,
     name: str,
     actor_id: Optional[ActorID] = None,
+    caller_addr: Optional[Tuple[str, int]] = None,
 ) -> dict:
     """Run user code; package returns (inline small / shared-memory big)."""
+    from ray_tpu._private.serialization import collect_object_refs
+
     w = worker_mod.global_worker
     w.set_task_context(task_id, actor_id)
     try:
@@ -162,7 +166,26 @@ def _execute_callable(
                 raise ValueError(f"expected {num_returns} return values, got {len(values)}")
         returns = []
         for i, v in enumerate(values):
-            data = serialize(v)
+            with collect_object_refs() as col:
+                data = serialize(v)
+            # refs nested in the return value: register the CALLER as
+            # borrower with each owner BEFORE replying, while our own
+            # refs still pin the objects (reference_counter.h:44 —
+            # borrower handoff on task return)
+            if col.refs and caller_addr is not None:
+                for r in col.refs:
+                    owner = r.owner_address or w.core.address
+                    if tuple(owner) == tuple(caller_addr):
+                        continue  # caller owns it already
+                    try:
+                        get_client(tuple(owner)).call(
+                            "AddBorrower",
+                            object_id_bin=r.id().binary(),
+                            borrower=tuple(caller_addr),
+                            timeout=10,
+                        )
+                    except Exception:
+                        pass
             if len(data) <= config.object_store_inline_max_bytes:
                 returns.append({"kind": "inline", "data": data})
             else:
@@ -247,6 +270,7 @@ class WorkerServer:
                     ]
                 }
             self._function_cache[fn_bytes] = fn
+        caller_addr = spec_payload.get("caller_addr")
         fut = self._task_pool.submit(
             _execute_callable,
             lambda args, kwargs: fn(*args, **kwargs),
@@ -255,6 +279,8 @@ class WorkerServer:
             spec_payload["num_returns"],
             TaskID(spec_payload["task_id"]),
             spec_payload["function_name"],
+            None,
+            tuple(caller_addr) if caller_addr else None,
         )
         return fut.result()
 

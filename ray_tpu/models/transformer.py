@@ -1,0 +1,325 @@
+"""Flagship model family: Llama-style decoder-only transformer, TPU-first.
+
+Pure-functional JAX (no module framework): a model is (config, params
+pytree, apply fn). Every parameter leaf has a matching *logical axis*
+tuple (see ``param_axes``) that ray_tpu.parallel.sharding maps onto the
+device mesh — so DP/FSDP/TP/SP are all just rule-table choices over one
+program (SURVEY.md §2.3 "parallelism strategies").
+
+The reference framework has no native models (it defers to torch/vLLM;
+SURVEY.md §2.4) — here the flagship model lives inside the framework
+because Train/Serve/bench all drive it.
+
+Design notes (TPU):
+- matmuls in bfloat16 with fp32 accumulation (``preferred_element_type``),
+  params kept fp32 by default (master weights), cast per-step.
+- attention = ops.flash_attention (pallas on TPU) or ops.ring_attention
+  when the sequence axis is sharded.
+- ``jax.checkpoint`` per block to trade FLOPs for HBM (long context).
+- rotary embeddings computed on the fly (no cached tables → no host
+  transfers, fuses into the kernel).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.ops.attention import flash_attention, gqa_expand
+from ray_tpu.parallel.sharding import constrain
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    """Hyperparameters for the Llama family (reference parity target:
+    the Llama-2-7B LoRA fine-tune from BASELINE.md)."""
+
+    vocab_size: int = 32000
+    hidden: int = 4096
+    mlp_hidden: int = 11008
+    layers: int = 32
+    heads: int = 32
+    kv_heads: int = 32
+    head_dim: Optional[int] = None  # default hidden // heads
+    max_seq: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16  # activation/compute dtype
+    param_dtype: Any = jnp.float32  # master weights
+    remat: bool = True  # jax.checkpoint each block
+    lora_rank: int = 0  # 0 = dense training; >0 = LoRA adapters on attn+mlp
+    lora_alpha: float = 16.0
+
+    @property
+    def hd(self) -> int:
+        return self.head_dim or self.hidden // self.heads
+
+    def flops_per_token(self) -> float:
+        """Approx forward+backward FLOPs/token (6*N + attention), for MFU."""
+        n_params = self.num_params()
+        attn = 12 * self.layers * self.hidden * self.max_seq  # rough
+        return 6 * n_params + attn
+
+    def num_params(self) -> int:
+        h, m, l, v = self.hidden, self.mlp_hidden, self.layers, self.vocab_size
+        hd, nh, nkv = self.hd, self.heads, self.kv_heads
+        per_layer = h * (nh * hd) + 2 * h * (nkv * hd) + (nh * hd) * h + 3 * h * m + 2 * h
+        emb = v * h * (1 if self.tie_embeddings else 2)
+        return l * per_layer + emb + h
+
+
+# Presets. llama2_7b mirrors the reference north-star target
+# (BASELINE.md "Train Llama-2-7B LoRA ... v5e-64").
+PRESETS: Dict[str, TransformerConfig] = {
+    "debug": TransformerConfig(
+        vocab_size=512, hidden=128, mlp_hidden=352, layers=2, heads=4,
+        kv_heads=2, max_seq=128, remat=False,
+    ),
+    "tiny": TransformerConfig(
+        vocab_size=2048, hidden=256, mlp_hidden=704, layers=4, heads=8,
+        kv_heads=4, max_seq=512,
+    ),
+    "llama2_7b": TransformerConfig(),
+    "llama2_7b_lora": TransformerConfig(lora_rank=16),
+    "llama3_8b": TransformerConfig(
+        vocab_size=128256, hidden=4096, mlp_hidden=14336, layers=32,
+        heads=32, kv_heads=8, max_seq=8192, rope_theta=500000.0,
+    ),
+}
+
+
+def config(name_or_cfg, **overrides) -> TransformerConfig:
+    cfg = PRESETS[name_or_cfg] if isinstance(name_or_cfg, str) else name_or_cfg
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg
+
+
+# ---------------------------------------------------------------------------
+# Parameter init + logical axes
+# ---------------------------------------------------------------------------
+
+def _dense_init(key, shape, dtype, fan_in):
+    return (jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)).astype(dtype)
+
+
+def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
+    """Initialize the parameter pytree. Layer params are STACKED on a
+    leading ``layers`` dim so the forward is one ``lax.scan`` — one XLA
+    while-loop body compiled once, not ``layers`` inlined copies (compile
+    time and HBM win on TPU)."""
+    h, m, v, l = cfg.hidden, cfg.mlp_hidden, cfg.vocab_size, cfg.layers
+    hd, nh, nkv = cfg.hd, cfg.heads, cfg.kv_heads
+    pd = cfg.param_dtype
+    keys = jax.random.split(key, 12)
+
+    def stack(k, shape, fan_in):
+        ks = jax.random.split(k, l)
+        return jnp.stack([_dense_init(ks[i], shape, pd, fan_in) for i in range(l)])
+
+    params: Params = {
+        "embed": _dense_init(keys[0], (v, h), pd, h),  # scaled like output
+        "blocks": {
+            "wq": stack(keys[1], (h, nh, hd), h),
+            "wk": stack(keys[2], (h, nkv, hd), h),
+            "wv": stack(keys[3], (h, nkv, hd), h),
+            "wo": stack(keys[4], (nh, hd, h), nh * hd),
+            "wi_gate": stack(keys[5], (h, m), h),
+            "wi_up": stack(keys[6], (h, m), h),
+            "wo_mlp": stack(keys[7], (m, h), m),
+            "ln_attn": jnp.ones((l, h), pd),
+            "ln_mlp": jnp.ones((l, h), pd),
+        },
+        "ln_f": jnp.ones((h,), pd),
+    }
+    if not cfg.tie_embeddings:
+        params["unembed"] = _dense_init(keys[8], (h, v), pd, h)
+    if cfg.lora_rank:
+        r = cfg.lora_rank
+        def lz(shape):  # LoRA B starts at zero
+            return jnp.zeros(shape, pd)
+        params["lora"] = {
+            "wq_a": stack(keys[9], (h, r), h), "wq_b": jnp.zeros((l, r, nh * hd), pd),
+            "wv_a": stack(keys[10], (h, r), h), "wv_b": jnp.zeros((l, r, nkv * hd), pd),
+            "wi_a": stack(keys[11], (h, r), h), "wi_b": lz((l, r, m)),
+        }
+    return params
+
+
+def param_axes(cfg: TransformerConfig) -> Params:
+    """Pytree of logical-axis tuples mirroring init_params output.
+    Feed to parallel.sharding.tree_shardings(mesh, ...) for NamedShardings."""
+    axes: Params = {
+        "embed": ("vocab", "embed"),
+        "blocks": {
+            "wq": ("layers", "embed", "heads", "head_dim"),
+            "wk": ("layers", "embed", "kv_heads", "head_dim"),
+            "wv": ("layers", "embed", "kv_heads", "head_dim"),
+            "wo": ("layers", "heads", "head_dim", "embed"),
+            "wi_gate": ("layers", "embed", "mlp"),
+            "wi_up": ("layers", "embed", "mlp"),
+            "wo_mlp": ("layers", "mlp", "embed"),
+            "ln_attn": ("layers", "norm"),
+            "ln_mlp": ("layers", "norm"),
+        },
+        "ln_f": ("norm",),
+    }
+    if not cfg.tie_embeddings:
+        axes["unembed"] = ("embed", "vocab")
+    if cfg.lora_rank:
+        axes["lora"] = {
+            "wq_a": ("layers", "embed", "lora_rank"), "wq_b": ("layers", "lora_rank", "heads"),
+            "wv_a": ("layers", "embed", "lora_rank"), "wv_b": ("layers", "lora_rank", "kv_heads"),
+            "wi_a": ("layers", "embed", "lora_rank"), "wi_b": ("layers", "lora_rank", "mlp"),
+        }
+    return axes
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _rms_norm(x, w, eps):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * lax.rsqrt(var + eps)).astype(x.dtype) * w.astype(x.dtype)
+
+
+def _rope(x, positions, theta):
+    """Rotary embedding. x [B,S,H,D], positions [B,S] or [S]."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d // 2, dtype=jnp.float32) / (d // 2))
+    if positions.ndim == 1:
+        positions = positions[None, :]
+    ang = positions[..., None].astype(jnp.float32) * freqs  # [B,S,D/2]
+    cos, sin = jnp.cos(ang)[:, :, None, :], jnp.sin(ang)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _lora_delta(x, a, b, scale):
+    return jnp.einsum("bsh,hr->bsr", x, a.astype(x.dtype)) @ b.astype(x.dtype) * scale
+
+
+def _block(cfg: TransformerConfig, x, layer_params, lora_params, positions,
+           attn_fn):
+    """One decoder block. x [B,S,H_emb] in compute dtype."""
+    p = layer_params
+    nh, nkv, hd = cfg.heads, cfg.kv_heads, cfg.hd
+    b, s, h = x.shape
+    scale = cfg.lora_alpha / cfg.lora_rank if cfg.lora_rank else 0.0
+
+    y = _rms_norm(x, p["ln_attn"], cfg.norm_eps)
+    q = jnp.einsum("bsh,hnd->bsnd", y, p["wq"].astype(y.dtype))
+    k = jnp.einsum("bsh,hnd->bsnd", y, p["wk"].astype(y.dtype))
+    v = jnp.einsum("bsh,hnd->bsnd", y, p["wv"].astype(y.dtype))
+    if lora_params is not None:
+        q = q + _lora_delta(y, lora_params["wq_a"], lora_params["wq_b"], scale).reshape(b, s, nh, hd)
+        v = v + _lora_delta(y, lora_params["wv_a"], lora_params["wv_b"], scale).reshape(b, s, nkv, hd)
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    q = constrain(q, ("batch", "seq", "heads", None))
+    attn = attn_fn(q, k, v)
+    attn = jnp.einsum("bsnd,ndh->bsh", attn, p["wo"].astype(attn.dtype))
+    x = x + constrain(attn, ("batch", "seq", "embed"))
+
+    y = _rms_norm(x, p["ln_mlp"], cfg.norm_eps)
+    gate = jnp.einsum("bsh,hm->bsm", y, p["wi_gate"].astype(y.dtype))
+    up = jnp.einsum("bsh,hm->bsm", y, p["wi_up"].astype(y.dtype))
+    if lora_params is not None:
+        gate = gate + _lora_delta(y, lora_params["wi_a"], lora_params["wi_b"], scale)
+    act = jax.nn.silu(gate) * up
+    act = constrain(act, ("batch", "seq", "mlp"))
+    out = jnp.einsum("bsm,mh->bsh", act, p["wo_mlp"].astype(act.dtype))
+    return x + constrain(out, ("batch", "seq", "embed"))
+
+
+def _default_attn(cfg: TransformerConfig):
+    def attn(q, k, v):
+        k, v = gqa_expand(k, v, cfg.heads)
+        return flash_attention(q, k, v, causal=True)
+    return attn
+
+
+def forward(cfg: TransformerConfig, params: Params, tokens: jax.Array,
+            positions: Optional[jax.Array] = None,
+            attn_fn=None) -> jax.Array:
+    """tokens [B,S] int32 → logits [B,S,V] (compute dtype).
+
+    ``attn_fn(q,k,v)->o`` overrides attention — ring_attention for
+    sequence parallelism is passed in by the train-step builder.
+    """
+    if positions is None:
+        positions = jnp.arange(tokens.shape[1])
+    attn_fn = attn_fn or _default_attn(cfg)
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = constrain(x, ("batch", "seq", "embed"))
+
+    blocks, lora = params["blocks"], params.get("lora")
+
+    def body(x, layer):
+        lp = layer["p"]
+        lo = layer.get("l")
+        out = _block(cfg, x, lp, lo, positions, attn_fn)
+        return out, None
+
+    layer_tree = {"p": blocks}
+    if lora is not None:
+        layer_tree["l"] = lora
+    body_fn = jax.checkpoint(body) if cfg.remat else body
+    x, _ = lax.scan(body_fn, x, layer_tree)
+
+    x = _rms_norm(x, params["ln_f"], cfg.norm_eps)
+    unembed = params.get("unembed")
+    if unembed is None:
+        unembed = params["embed"].T
+    logits = jnp.einsum("bsh,hv->bsv", x, unembed.astype(x.dtype))
+    return constrain(logits, ("batch", "seq", "vocab"))
+
+
+def loss_fn(cfg: TransformerConfig, params: Params, batch: Dict[str, jax.Array],
+            attn_fn=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Next-token cross-entropy. batch: tokens [B,S], optional loss_mask [B,S].
+    Returns (loss, metrics)."""
+    tokens = batch["tokens"]
+    # Forward over the FULL sequence (sequence-parallel shards must keep
+    # S divisible by the mesh axis); shift at the logits instead.
+    logits = forward(cfg, params, tokens, attn_fn=attn_fn)[:, :-1]
+    targets = tokens[:, 1:]
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    tgt_logit = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = logz - tgt_logit
+    mask = batch.get("loss_mask")
+    if mask is not None:
+        mask = mask[:, 1:].astype(jnp.float32)
+        denom = jnp.maximum(mask.sum(), 1.0)
+        loss = (nll * mask).sum() / denom
+    else:
+        denom = jnp.asarray(nll.size, jnp.float32)
+        loss = nll.mean()
+    acc = (logits.argmax(-1) == targets).astype(jnp.float32)
+    if mask is not None:
+        acc = (acc * mask).sum() / denom
+    else:
+        acc = acc.mean()
+    return loss, {"loss": loss, "accuracy": acc, "tokens": denom}
+
+
+def trainable_mask(cfg: TransformerConfig, params: Params) -> Params:
+    """True where a param trains: everything for dense, only adapters for
+    LoRA (the reference's LoRA target trains adapters only)."""
+    if not cfg.lora_rank:
+        return jax.tree.map(lambda _: True, params)
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: any(getattr(k, "key", None) == "lora" for k in path),
+        params,
+    )

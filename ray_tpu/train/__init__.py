@@ -1,0 +1,50 @@
+"""ray_tpu.train — distributed training on TPU (reference: python/ray/train).
+
+Public surface mirrors Train v2: JaxTrainer, ScalingConfig/RunConfig/
+FailureConfig/CheckpointConfig, report/get_context/get_checkpoint,
+Checkpoint. The GSPMD step builder (ray_tpu.train.step) replaces the
+reference's torch DDP/FSDP wrappers (SURVEY.md §2.3)."""
+
+from ray_tpu.train.checkpoint import (
+    Checkpoint,
+    CheckpointManager,
+    restore_state,
+    save_state,
+)
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.jax_trainer import JaxTrainer
+from ray_tpu.train.session import get_checkpoint, get_context, report
+from ray_tpu.train.step import (
+    default_optimizer,
+    init_state,
+    make_eval_step,
+    make_train_step,
+    state_shardings,
+)
+
+__all__ = [
+    "Checkpoint",
+    "CheckpointManager",
+    "CheckpointConfig",
+    "FailureConfig",
+    "JaxTrainer",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "default_optimizer",
+    "get_checkpoint",
+    "get_context",
+    "init_state",
+    "make_eval_step",
+    "make_train_step",
+    "report",
+    "restore_state",
+    "save_state",
+    "state_shardings",
+]

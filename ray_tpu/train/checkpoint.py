@@ -1,0 +1,155 @@
+"""Checkpoints: directory + URI handle, orbax-backed sharded array state.
+
+Reference surface: `ray.train.Checkpoint` (train/_checkpoint.py:56 — a
+directory with an fsspec URI) and the keep-K `CheckpointManager`
+(train/v2/_internal/execution/checkpoint/checkpoint_manager.py).
+
+TPU twist (SURVEY.md §5 "Checkpoint/resume"): model/optimizer state is
+a sharded jax pytree — saved via orbax (async, per-shard files, restore
+onto a *different* mesh works because orbax records the global shape and
+we supply target shardings at restore)."""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+
+class Checkpoint:
+    """A directory of checkpoint data (reference: train/_checkpoint.py:56)."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def as_directory(self) -> str:
+        return self.path
+
+    def to_directory(self, dest: Optional[str] = None) -> str:
+        dest = dest or tempfile.mkdtemp(prefix="ckpt_")
+        if os.path.abspath(dest) != self.path:
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    # -- metrics sidecar -------------------------------------------------
+    def update_metadata(self, metadata: Dict[str, Any]) -> None:
+        with open(os.path.join(self.path, ".metadata.json"), "w") as f:
+            json.dump(metadata, f)
+
+    def get_metadata(self) -> Dict[str, Any]:
+        p = os.path.join(self.path, ".metadata.json")
+        if os.path.exists(p):
+            with open(p) as f:
+                return json.load(f)
+        return {}
+
+    def __repr__(self):
+        return f"Checkpoint(path={self.path!r})"
+
+
+# ---------------------------------------------------------------------------
+# Sharded jax-state save/restore (orbax)
+# ---------------------------------------------------------------------------
+
+def save_state(state: Any, directory: str) -> None:
+    """Save a jax pytree (possibly sharded over a Mesh) to `directory`.
+    Multi-host-safe: orbax coordinates per-host shard writes."""
+    import orbax.checkpoint as ocp
+
+    directory = os.path.abspath(directory)
+    os.makedirs(os.path.dirname(directory) or ".", exist_ok=True)
+    ckptr = ocp.StandardCheckpointer()
+    tmp = directory + ".tmp"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    ckptr.save(tmp, state)
+    ckptr.wait_until_finished()
+    if os.path.exists(directory):
+        shutil.rmtree(directory)
+    os.replace(tmp, directory)
+
+
+def restore_state(directory: str, target: Any = None, shardings: Any = None) -> Any:
+    """Restore a pytree. `target` (abstract shapes) and/or `shardings`
+    re-lay the arrays onto the current mesh — elastic restarts restore a
+    checkpoint written on N hosts onto M hosts (SURVEY.md §5)."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    if target is not None and shardings is not None:
+        abstract = jax.tree.map(
+            lambda t, s: jax.ShapeDtypeStruct(t.shape, t.dtype, sharding=s),
+            target, shardings,
+        )
+        return ckptr.restore(os.path.abspath(directory), abstract)
+    if target is not None:
+        abstract = jax.tree.map(
+            lambda t: jax.ShapeDtypeStruct(t.shape, t.dtype), target
+        )
+        return ckptr.restore(os.path.abspath(directory), abstract)
+    return ckptr.restore(os.path.abspath(directory))
+
+
+class CheckpointManager:
+    """Keep-K retention over a storage dir (reference:
+    v2/_internal/execution/checkpoint/checkpoint_manager.py)."""
+
+    def __init__(self, storage_path: str, num_to_keep: Optional[int] = None):
+        self.storage_path = os.path.abspath(storage_path)
+        self.num_to_keep = num_to_keep
+        os.makedirs(self.storage_path, exist_ok=True)
+        self._history: List[Dict[str, Any]] = []
+        self._load_index()
+
+    def _index_path(self) -> str:
+        return os.path.join(self.storage_path, ".ckpt_index.json")
+
+    def _load_index(self) -> None:
+        if os.path.exists(self._index_path()):
+            with open(self._index_path()) as f:
+                self._history = json.load(f)
+
+    def _save_index(self) -> None:
+        with open(self._index_path(), "w") as f:
+            json.dump(self._history, f)
+
+    def register(self, checkpoint: Checkpoint, metrics: Optional[Dict] = None) -> Checkpoint:
+        """Move a reported checkpoint into managed storage; evict oldest
+        beyond num_to_keep."""
+        seq = (self._history[-1]["seq"] + 1) if self._history else 0
+        dest = os.path.join(self.storage_path, f"checkpoint_{seq:06d}")
+        if os.path.abspath(checkpoint.path) != dest:
+            if os.path.exists(dest):
+                shutil.rmtree(dest)
+            shutil.move(checkpoint.path, dest)
+        managed = Checkpoint(dest)
+        if metrics:
+            managed.update_metadata({"metrics": metrics, "time": time.time()})
+        self._history.append({"seq": seq, "path": dest, "metrics": metrics or {}})
+        if self.num_to_keep is not None:
+            while len(self._history) > self.num_to_keep:
+                old = self._history.pop(0)
+                if os.path.exists(old["path"]):
+                    shutil.rmtree(old["path"])
+        self._save_index()
+        return managed
+
+    def latest(self) -> Optional[Checkpoint]:
+        if not self._history:
+            return None
+        return Checkpoint(self._history[-1]["path"])
+
+    def best(self, metric: str, mode: str = "min") -> Optional[Checkpoint]:
+        scored = [h for h in self._history if metric in h["metrics"]]
+        if not scored:
+            return self.latest()
+        pick = (min if mode == "min" else max)(scored, key=lambda h: h["metrics"][metric])
+        return Checkpoint(pick["path"])

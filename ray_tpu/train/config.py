@@ -1,0 +1,77 @@
+"""Train/AIR config surface (reference: python/ray/air/config.py and
+python/ray/train/v2/jax/config.py:40 `JaxConfig`).
+
+TPU twist: `ScalingConfig` speaks topologies ("v5e-64") and a `MeshSpec`
+instead of `num_gpus_per_worker` — the mesh is the parallelism plan
+(SURVEY.md §7 design stance)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+from ray_tpu.parallel.mesh import MeshSpec
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many workers and what accelerator shape each gets.
+
+    num_workers = host processes (1 actor per TPU host, reference:
+    train/v2/api/data_parallel_trainer.py). `topology` reserves a whole
+    slice via SlicePlacementGroup semantics (util/tpu.py:420)."""
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    topology: Optional[str] = None  # e.g. "v5e-64"
+    chips_per_worker: Optional[int] = None
+    num_cpus_per_worker: float = 1.0
+    resources_per_worker: Optional[Dict[str, float]] = None
+    mesh: MeshSpec = dataclasses.field(default_factory=lambda: MeshSpec(data=-1))
+    num_slices: int = 1  # >1 = multi-slice (MEGASCALE over DCN)
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        res.setdefault("CPU", self.num_cpus_per_worker)
+        if self.use_tpu and self.chips_per_worker:
+            res.setdefault("TPU", self.chips_per_worker)
+        return res
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """Retry budget for worker-group failures (reference:
+    train/v2/_internal/execution/failure_handling/failure_policy.py:14)."""
+
+    max_failures: int = 0  # 0 = fail fast; -1 = infinite retries
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    """Keep-K checkpoint retention (reference:
+    train/v2/_internal/execution/checkpoint/checkpoint_manager.py)."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_frequency: int = 0  # steps between auto-checkpoints (0 = manual)
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None  # local dir or fsspec URI
+    failure_config: FailureConfig = dataclasses.field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
+
+
+@dataclasses.dataclass
+class Result:
+    """What `.fit()` returns (reference: python/ray/air/result.py)."""
+
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Any]  # train.Checkpoint
+    error: Optional[BaseException] = None
+    path: Optional[str] = None
+
+    @property
+    def best_checkpoint(self):
+        return self.checkpoint

@@ -1,0 +1,204 @@
+"""Sharded train-step builder — the GSPMD heart of Train.
+
+In the reference, parallelism is delegated to torch DDP/FSDP wrappers
+(train/torch/train_loop_utils.py:178,187); here DP/FSDP/TP/SP are all
+NamedSharding choices over ONE jitted program (SURVEY.md §2.3):
+
+- params/optimizer state sharded by logical-axis rules (fsdp/tensor),
+- batch sharded over (replica, data, fsdp) × sequence,
+- gradients all-reduced implicitly by GSPMD over the data axes,
+- sequence axis > 1 switches attention to ring_attention under
+  shard_map (exact, comms overlap compute on ICI).
+
+Everything compiles to a single XLA program per step; donated input
+state keeps HBM flat."""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.8 moved shard_map to the top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from ray_tpu.models.transformer import (
+    TransformerConfig, forward, init_params, loss_fn, param_axes, trainable_mask,
+)
+from ray_tpu.ops.attention import gqa_expand
+from ray_tpu.ops.ring_attention import ring_attention
+from ray_tpu.parallel.mesh import mesh_axis_size
+from ray_tpu.parallel.sharding import (
+    DEFAULT_RULES, Rules, named_sharding, spec_for, tree_shardings,
+)
+
+TrainState = Dict[str, Any]
+
+
+def default_optimizer(cfg: TransformerConfig, lr: float = 3e-4,
+                      weight_decay: float = 0.1,
+                      params_template: Optional[Any] = None) -> optax.GradientTransformation:
+    """AdamW + global-norm clip; LoRA configs train only adapter leaves
+    via optax.masked (reference target: Llama LoRA fine-tune, BASELINE.md)."""
+    tx = optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=weight_decay),
+    )
+    if cfg.lora_rank:
+        # multi_transform (not optax.masked — masked passes frozen-leaf
+        # gradients through unchanged) so frozen params get zero updates.
+        labels = lambda params: jax.tree.map(
+            lambda t: "train" if t else "freeze", trainable_mask(cfg, params)
+        )
+        tx = optax.multi_transform(
+            {"train": tx, "freeze": optax.set_to_zero()}, labels
+        )
+    return tx
+
+
+def make_attn_fn(cfg: TransformerConfig, mesh: Mesh,
+                 rules: Optional[Rules] = None) -> Optional[Callable]:
+    """Ring attention under shard_map when the sequence axis is sharded;
+    None (→ flash/blockwise under pure GSPMD) otherwise."""
+    rules = rules or DEFAULT_RULES
+    if mesh_axis_size(mesh, "sequence") <= 1:
+        return None
+    q_spec = spec_for(("batch", "seq", "heads", "head_dim"), rules, mesh)
+    kv_spec = spec_for(("batch", "seq", "kv_heads", "head_dim"), rules, mesh)
+
+    def attn(q, k, v):
+        def inner(q, k, v):
+            k, v = gqa_expand(k, v, q.shape[2])  # local head counts
+            return ring_attention(q, k, v, axis_name="sequence", causal=True)
+
+        return _shard_map(
+            inner, mesh=mesh,
+            in_specs=(q_spec, kv_spec, kv_spec), out_specs=q_spec,
+            check_vma=False,
+        )(q, k, v)
+
+    return attn
+
+
+def state_shardings(cfg: TransformerConfig, optimizer: optax.GradientTransformation,
+                    mesh: Mesh, rules: Optional[Rules] = None) -> TrainState:
+    """NamedShardings for the full train state. Optimizer-state leaves
+    that mirror params (adam mu/nu) inherit the param shardings via
+    optax.tree_map_params; scalars replicate."""
+    axes = param_axes(cfg)
+    p_shard = tree_shardings(mesh, axes, rules)
+    repl = NamedSharding(mesh, P())
+
+    params_shape = jax.eval_shape(lambda: init_params(cfg, jax.random.key(0)))
+    opt_shape = jax.eval_shape(optimizer.init, params_shape)
+    try:
+        opt_shard = optax.tree_map_params(
+            optimizer,
+            lambda _, s: s,
+            opt_shape,
+            p_shard,
+            transform_non_params=lambda _: repl,
+        )
+    except Exception:  # fallback: replicate optimizer state
+        opt_shard = jax.tree.map(lambda _: repl, opt_shape)
+    return {"params": p_shard, "opt_state": opt_shard,
+            "step": repl, "rng": repl}
+
+
+def batch_sharding(mesh: Mesh, rules: Optional[Rules] = None) -> NamedSharding:
+    """tokens [B, S] → sharded (batch, seq)."""
+    return named_sharding(mesh, ("batch", "seq"), rules)
+
+
+def init_state(cfg: TransformerConfig, optimizer: optax.GradientTransformation,
+               mesh: Mesh, rules: Optional[Rules] = None,
+               seed: int = 0) -> TrainState:
+    """Initialize the train state directly sharded (no host-side full
+    materialization — params of a 7B model never exist unsharded)."""
+    shardings = state_shardings(cfg, optimizer, mesh, rules)
+
+    def _init(key):
+        params = init_params(cfg, key)
+        return {
+            "params": params,
+            "opt_state": optimizer.init(params),
+            "step": jnp.zeros((), jnp.int32),
+            "rng": jax.random.key_data(jax.random.key(seed)),
+        }
+
+    with jax.set_mesh(mesh):
+        return jax.jit(_init, out_shardings=shardings)(jax.random.key(seed))
+
+
+def make_train_step(cfg: TransformerConfig, optimizer: optax.GradientTransformation,
+                    mesh: Mesh, rules: Optional[Rules] = None,
+                    donate: bool = True) -> Callable[[TrainState, Dict], Tuple[TrainState, Dict]]:
+    """Build the jitted sharded train step: (state, batch) → (state, metrics)."""
+    rules = rules or DEFAULT_RULES
+    attn = make_attn_fn(cfg, mesh, rules)
+    shardings = state_shardings(cfg, optimizer, mesh, rules)
+    b_shard = batch_sharding(mesh, rules)
+    repl = NamedSharding(mesh, P())
+
+    def step(state: TrainState, batch: Dict[str, jax.Array]):
+        params = state["params"]
+
+        def lf(p):
+            return loss_fn(cfg, p, batch, attn_fn=attn)
+
+        (loss, metrics), grads = jax.value_and_grad(lf, has_aux=True)(params)
+        updates, new_opt = optimizer.update(grads, state["opt_state"], params)
+        new_params = optax.apply_updates(params, updates)
+        gnorm = optax.global_norm(grads)
+        metrics = dict(metrics, grad_norm=gnorm)
+        new_state = {
+            "params": new_params,
+            "opt_state": new_opt,
+            "step": state["step"] + 1,
+            "rng": state["rng"],
+        }
+        return new_state, metrics
+
+    in_batch_shardings = {"tokens": b_shard}
+    jit_kwargs = dict(
+        in_shardings=(shardings, None),
+        out_shardings=(shardings, repl),
+    )
+    if donate:
+        jit_kwargs["donate_argnums"] = (0,)
+    jitted = jax.jit(step, **jit_kwargs)
+
+    def run(state, batch):
+        batch = {k: jax.device_put(v, b_shard if v.ndim >= 2 else repl)
+                 for k, v in batch.items()}
+        with jax.set_mesh(mesh):
+            return jitted(state, batch)
+
+    run._jitted = jitted
+    run._shardings = shardings
+    run._batch_sharding = b_shard
+    return run
+
+
+def make_eval_step(cfg: TransformerConfig, mesh: Mesh,
+                   rules: Optional[Rules] = None) -> Callable:
+    """(params, batch) → metrics, no grad."""
+    rules = rules or DEFAULT_RULES
+    attn = make_attn_fn(cfg, mesh, rules)
+
+    @jax.jit
+    def step(params, batch):
+        _, metrics = loss_fn(cfg, params, batch, attn_fn=attn)
+        return metrics
+
+    def run(params, batch):
+        with jax.set_mesh(mesh):
+            return step(params, batch)
+
+    return run

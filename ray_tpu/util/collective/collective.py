@@ -1,0 +1,124 @@
+"""ray_tpu.util.collective — process-group collective API.
+
+Reference surface: python/ray/util/collective/collective.py (816 LoC) —
+`init_collective_group` (:149), `create_collective_group` (:186),
+`allreduce` (:312), `barrier` (:352), `broadcast` (:421), `allgather`
+(:468), `reducescatter` (:511), `send`/`recv` (:567,624).
+
+TPU-native backends (SURVEY.md §2.3): XLA (eager ICI collectives, no
+NCCL rendezvous) and OBJSTORE (gloo-equivalent host fallback through
+the shared-memory object store)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.util.collective.types import Backend, ReduceOp
+
+_groups: Dict[str, Any] = {}
+_lock = threading.Lock()
+
+
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    backend: str = "xla",
+    group_name: str = "default",
+) -> None:
+    """Declare this process a member of a collective group
+    (reference: collective.py:149)."""
+    backend = Backend.resolve(backend)
+    with _lock:
+        if group_name in _groups:
+            raise RuntimeError(f"Group {group_name} already initialized")
+        if backend == Backend.XLA:
+            from ray_tpu.util.collective.xla_group import XLAGroup
+
+            _groups[group_name] = XLAGroup(world_size, rank, group_name)
+        else:
+            from ray_tpu.util.collective.objstore_group import ObjStoreGroup
+
+            _groups[group_name] = ObjStoreGroup(world_size, rank, group_name)
+
+
+def create_collective_group(
+    actors: List[Any],
+    world_size: int,
+    ranks: List[int],
+    backend: str = "objstore",
+    group_name: str = "default",
+) -> None:
+    """Declarative setup: make `actors` a collective group by invoking
+    init on each (reference: collective.py:186)."""
+    import ray_tpu
+
+    futs = [
+        a._init_collective.remote(world_size, r, backend, group_name)
+        if hasattr(a, "_init_collective")
+        else a.__ray_call__.remote(
+            lambda self, w=world_size, rk=r, b=backend, g=group_name:
+            init_collective_group(w, rk, b, g)
+        )
+        for a, r in zip(actors, ranks)
+    ]
+    ray_tpu.get(futs)
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    return group_name in _groups
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    with _lock:
+        _groups.pop(group_name, None)
+
+
+def get_rank(group_name: str = "default") -> int:
+    g = _groups.get(group_name)
+    return g.rank if g else -1
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    g = _groups.get(group_name)
+    return g.world_size if g else -1
+
+
+def _group(group_name: str):
+    g = _groups.get(group_name)
+    if g is None:
+        raise RuntimeError(
+            f"Collective group '{group_name}' is not initialized; call "
+            "init_collective_group() first."
+        )
+    return g
+
+
+def allreduce(tensor: Any, group_name: str = "default",
+              op: ReduceOp = ReduceOp.SUM):
+    return _group(group_name).allreduce(tensor, op)
+
+
+def allgather(tensor: Any, group_name: str = "default"):
+    return _group(group_name).allgather(tensor)
+
+
+def reducescatter(tensor: Any, group_name: str = "default",
+                  op: ReduceOp = ReduceOp.SUM):
+    return _group(group_name).reducescatter(tensor, op)
+
+
+def broadcast(tensor: Any, src_rank: int = 0, group_name: str = "default"):
+    return _group(group_name).broadcast(tensor, src_rank)
+
+
+def barrier(group_name: str = "default") -> None:
+    _group(group_name).barrier()
+
+
+def send(tensor: Any, dst_rank: int, group_name: str = "default") -> None:
+    _group(group_name).send(tensor, dst_rank)
+
+
+def recv(src_rank: int, group_name: str = "default"):
+    return _group(group_name).recv(src_rank)

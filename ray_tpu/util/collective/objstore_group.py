@@ -1,0 +1,150 @@
+"""Object-store collective group — the gloo-equivalent CPU fallback.
+
+Reference: torch-gloo group (util/collective/collective_group/
+torch_gloo_collective_group.py:290) rendezvoused via a TCP store. Here
+the rendezvous is a **named actor** (the same named-actor pattern the
+reference uses for the NCCL unique-id store, nccl_collective_group.py:37)
+and the data plane is the shared-memory object store: each rank puts its
+contribution, the rendezvous hands back everyone's ObjectRefs, ranks
+reduce locally (zero-copy reads on one node).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.util.collective.types import ReduceOp
+
+_NUMPY_REDUCERS = {
+    ReduceOp.SUM: lambda xs: np.sum(xs, axis=0),
+    ReduceOp.PRODUCT: lambda xs: np.prod(xs, axis=0),
+    ReduceOp.MAX: lambda xs: np.max(xs, axis=0),
+    ReduceOp.MIN: lambda xs: np.min(xs, axis=0),
+    ReduceOp.MEAN: lambda xs: np.mean(xs, axis=0),
+}
+
+
+@ray_tpu.remote
+class _Rendezvous:
+    """Collects one ObjectRef per rank per (op sequence number), releases
+    the full set once world_size contributions arrive."""
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self._slots: Dict[Tuple[str, int], Dict[int, Any]] = {}
+
+    def put(self, key: str, seq: int, rank: int, ref: Any):
+        slot = self._slots.setdefault((key, seq), {})
+        slot[rank] = ref
+        return len(slot)
+
+    def collect(self, key: str, seq: int) -> Optional[List[Any]]:
+        slot = self._slots.get((key, seq), {})
+        if len(slot) < self.world_size:
+            return None
+        return [slot[r] for r in range(self.world_size)]
+
+    def collect_from(self, key: str, seq: int, rank: int) -> Optional[Any]:
+        """P2P: fetch a single rank's contribution (and clear it)."""
+        slot = self._slots.get((key, seq), {})
+        if rank not in slot:
+            return None
+        ref = slot.pop(rank)
+        if not slot:
+            self._slots.pop((key, seq), None)
+        return ref
+
+    def gc(self, key: str, seq: int):
+        self._slots.pop((key, seq), None)
+        return True
+
+
+class ObjStoreGroup:
+    """One instance per participating process/actor."""
+
+    def __init__(self, world_size: int, rank: int, group_name: str = "default"):
+        self.world_size = world_size
+        self.rank = rank
+        self.group_name = group_name
+        self._seq = 0
+        self._p2p_seqs: Dict[str, int] = {}
+        name = f"__collective_rdv_{group_name}"
+        if rank == 0:
+            try:
+                self._rdv = _Rendezvous.options(
+                    name=name, get_if_exists=True
+                ).remote(world_size)
+            except TypeError:
+                self._rdv = _Rendezvous.options(name=name).remote(world_size)
+        else:
+            self._rdv = self._wait_for_actor(name)
+
+    @staticmethod
+    def _wait_for_actor(name: str, timeout: float = 30.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                return ray_tpu.get_actor(name)
+            except Exception:
+                time.sleep(0.05)
+        raise TimeoutError(f"collective rendezvous actor {name} not found")
+
+    # ------------------------------------------------------------------
+    def _exchange(self, key: str, value: Any) -> List[Any]:
+        seq = self._seq
+        self._seq += 1
+        ref = ray_tpu.put(value)
+        ray_tpu.get(self._rdv.put.remote(key, seq, self.rank, [ref]))
+        deadline = time.time() + 120.0
+        while time.time() < deadline:
+            refs = ray_tpu.get(self._rdv.collect.remote(key, seq))
+            if refs is not None:
+                out = [ray_tpu.get(r[0]) for r in refs]
+                if self.rank == 0:
+                    self._rdv.gc.remote(key, seq)
+                return out
+            time.sleep(0.002)
+        raise TimeoutError(f"collective {key} timed out (seq={seq})")
+
+    def allreduce(self, tensor: Any, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
+        parts = self._exchange("allreduce", np.asarray(tensor))
+        return _NUMPY_REDUCERS[ReduceOp(op)](np.stack(parts))
+
+    def allgather(self, tensor: Any) -> List[np.ndarray]:
+        return self._exchange("allgather", np.asarray(tensor))
+
+    def reducescatter(self, tensor: Any, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
+        red = self.allreduce(tensor, op)
+        chunks = np.array_split(red, self.world_size, axis=0)
+        return chunks[self.rank]
+
+    def broadcast(self, tensor: Any, src_rank: int = 0) -> np.ndarray:
+        parts = self._exchange("broadcast", np.asarray(tensor))
+        return parts[src_rank]
+
+    def barrier(self) -> None:
+        self._exchange("barrier", np.zeros(()))
+
+    # -- p2p: per-pair sequence counters, single-rank collect -----------
+    def send(self, tensor: Any, dst_rank: int) -> None:
+        key = f"p2p_{self.rank}_{dst_rank}"
+        seq = self._p2p_seqs.get(key, 0)
+        self._p2p_seqs[key] = seq + 1
+        ref = ray_tpu.put(np.asarray(tensor))
+        ray_tpu.get(self._rdv.put.remote(key, seq, self.rank, [ref]))
+
+    def recv(self, src_rank: int) -> np.ndarray:
+        key = f"p2p_{src_rank}_{self.rank}"
+        seq = self._p2p_seqs.get(key, 0)
+        self._p2p_seqs[key] = seq + 1
+        deadline = time.time() + 120.0
+        while time.time() < deadline:
+            ref = ray_tpu.get(self._rdv.collect_from.remote(key, seq, src_rank))
+            if ref is not None:
+                return ray_tpu.get(ref[0])
+            time.sleep(0.002)
+        raise TimeoutError(f"recv from {src_rank} timed out (seq={seq})")

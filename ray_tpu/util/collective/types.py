@@ -1,0 +1,34 @@
+"""Collective types (reference: python/ray/util/collective/types.py:34).
+
+Backends, TPU-native:
+- XLA     : eager collectives compiled by XLA over the local device set
+            (ICI when devices are TPU chips; jax.distributed makes the
+            same path span hosts). Replaces NCCL.
+- OBJSTORE: host-side collectives through the object store with a
+            named-actor rendezvous — the gloo-equivalent CPU fallback
+            that works across worker processes.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class Backend(str, enum.Enum):
+    XLA = "xla"
+    OBJSTORE = "objstore"
+    # alias kept for reference-API compatibility (maps to OBJSTORE)
+    GLOO = "gloo"
+
+    @classmethod
+    def resolve(cls, name) -> "Backend":
+        b = cls(name) if not isinstance(name, cls) else name
+        return cls.OBJSTORE if b == cls.GLOO else b
+
+
+class ReduceOp(str, enum.Enum):
+    SUM = "sum"
+    PRODUCT = "product"
+    MAX = "max"
+    MIN = "min"
+    MEAN = "mean"

@@ -1,0 +1,123 @@
+"""XLA collective group — eager collectives over the jax device set.
+
+The TPU replacement for the reference's NCCL group
+(util/collective/collective_group/nccl_collective_group.py:850): no
+unique-id rendezvous, no streams — each op is a tiny jitted program over
+a 1D mesh; XLA lowers it to ICI collectives (multi-host when
+jax.distributed is initialized, so the same code spans a pod slice).
+
+Each *process* is one group member; the member's tensor may itself be
+sharded over that process's local devices — ops preserve sharding.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.util.collective.types import ReduceOp
+
+_REDUCERS = {
+    ReduceOp.SUM: jax.lax.psum,
+    ReduceOp.MAX: jax.lax.pmax,
+    ReduceOp.MIN: jax.lax.pmin,
+    ReduceOp.MEAN: lambda x, ax: jax.lax.pmean(x, ax),
+}
+
+
+class XLAGroup:
+    """Eager collective ops over the (global) jax device set.
+
+    In a multi-host group, `jax.distributed` must already be initialized
+    (parallel/bootstrap.py) so `jax.devices()` spans all hosts.
+    """
+
+    def __init__(self, world_size: int, rank: int, group_name: str = "default",
+                 devices: Optional[List[jax.Device]] = None):
+        self.world_size = world_size
+        self.rank = rank
+        self.group_name = group_name
+        devs = devices if devices is not None else jax.devices()
+        self._mesh = Mesh(np.asarray(devs), ("x",))
+        self._sharded = NamedSharding(self._mesh, P("x"))
+        self._repl = NamedSharding(self._mesh, P())
+
+    @property
+    def n_devices(self) -> int:
+        return len(self._mesh.devices.flat)
+
+    # -- device-level collectives (one entry per local device) ----------
+    @functools.lru_cache(maxsize=64)
+    def _allreduce_fn(self, op: ReduceOp):
+        mesh, repl = self._mesh, self._repl
+
+        @functools.partial(jax.jit, out_shardings=repl)
+        def f(x):
+            # x arrives device-sharded on axis 0 → reduce to replicated.
+            if op == ReduceOp.SUM:
+                return jnp.sum(x, axis=0)
+            if op == ReduceOp.MAX:
+                return jnp.max(x, axis=0)
+            if op == ReduceOp.MIN:
+                return jnp.min(x, axis=0)
+            if op == ReduceOp.MEAN:
+                return jnp.mean(x, axis=0)
+            if op == ReduceOp.PRODUCT:
+                return jnp.prod(x, axis=0)
+            raise ValueError(op)
+
+        return f
+
+    def allreduce(self, tensor: Any, op: ReduceOp = ReduceOp.SUM) -> jax.Array:
+        """Reduce one tensor-per-device. Accepts a list of per-device
+        arrays or a single array (treated as this member's contribution
+        replicated into a 1-device stack)."""
+        if isinstance(tensor, (list, tuple)):
+            stack = jax.device_put(
+                jnp.stack([jnp.asarray(t) for t in tensor]), self._sharded
+            )
+        else:
+            stack = jnp.asarray(tensor)[None]
+        return self._allreduce_fn(ReduceOp(op))(stack)
+
+    def allgather(self, tensor: Any) -> jax.Array:
+        if isinstance(tensor, (list, tuple)):
+            stack = jax.device_put(
+                jnp.stack([jnp.asarray(t) for t in tensor]), self._sharded
+            )
+            return jax.jit(lambda x: x, out_shardings=self._repl)(stack)
+        return jnp.asarray(tensor)[None]
+
+    def reducescatter(self, tensor: Any, op: ReduceOp = ReduceOp.SUM) -> jax.Array:
+        """Reduce then scatter chunks back over devices (sharded out)."""
+        if isinstance(tensor, (list, tuple)):
+            stack = jax.device_put(
+                jnp.stack([jnp.asarray(t) for t in tensor]), self._sharded
+            )
+        else:
+            stack = jnp.asarray(tensor)[None]
+        n = stack.shape[0]
+
+        @functools.partial(jax.jit, out_shardings=self._sharded)
+        def f(x):
+            red = jnp.sum(x, axis=0) if ReduceOp(op) == ReduceOp.SUM else (
+                jnp.mean(x, axis=0) if ReduceOp(op) == ReduceOp.MEAN else
+                jnp.max(x, axis=0)
+            )
+            return red.reshape((n, red.shape[0] // n) + red.shape[1:])
+
+        return f(stack)
+
+    def broadcast(self, tensor: Any, src_rank: int = 0) -> jax.Array:
+        """Replicate src's tensor onto all devices."""
+        x = jnp.asarray(tensor)
+        return jax.device_put(x, self._repl)
+
+    def barrier(self) -> None:
+        x = self.allreduce([jnp.ones(()) for _ in range(self.n_devices)])
+        jax.block_until_ready(x)
