@@ -26,6 +26,8 @@ _DEFINITIONS: Dict[str, Tuple[type, Any]] = {
     # --- event loop / rpc ---
     "rpc_connect_timeout_s": (float, 10.0),
     "rpc_call_timeout_s": (float, 60.0),
+    # actor __init__ runs user code (model builds, framework imports)
+    "actor_creation_timeout_s": (float, 600.0),
     "rpc_retry_base_delay_ms": (int, 100),
     "rpc_retry_max_delay_ms": (int, 5000),
     "rpc_max_retries": (int, 5),

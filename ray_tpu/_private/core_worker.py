@@ -1042,7 +1042,9 @@ class CoreWorker(CoreRuntime):
             raise ValueError(reply["error"])
         return ActorID.from_hex(reply["actor_id"])
 
-    def _resolve_actor(self, actor_id_hex: str, wait_alive_s: float = 60.0) -> Tuple[str, int]:
+    def _resolve_actor(self, actor_id_hex: str, wait_alive_s: float = 180.0) -> Tuple[str, int]:
+        # 180s: actor __init__ may legitimately cold-import jax and build
+        # a model inside a fresh worker process
         deadline = time.monotonic() + wait_alive_s
         cached = self._actor_addr_cache.get(actor_id_hex)
         if cached is not None:

@@ -390,7 +390,10 @@ class GcsServer:
                     "CreateActor",
                     actor_id=actor.actor_id,
                     serialized_spec=actor.serialized_spec,
-                    timeout=config.rpc_call_timeout_s,
+                    # actor __init__ is user code (may cold-import jax,
+                    # build models); the generic RPC timeout would abort
+                    # + re-lease in a loop, never letting init finish
+                    timeout=config.actor_creation_timeout_s,
                 )
                 worker.close()
             except Exception as e:  # noqa: BLE001
@@ -469,6 +472,19 @@ class GcsServer:
 
     async def ListActors(self) -> List[dict]:
         return [await self.GetActorInfo(aid) for aid in list(self.actors)]
+
+    async def ListPlacementGroups(self) -> List[dict]:
+        return [
+            {
+                "placement_group_id": pg.pg_id,
+                "name": pg.name,
+                "state": pg.state,
+                "strategy": pg.strategy,
+                "bundles": pg.bundles,
+                "bundle_nodes": dict(pg.bundle_nodes),
+            }
+            for pg in self.placement_groups.values()
+        ]
 
     async def ReportActorFault(self, actor_id: str, worker_addr: Tuple[str, int], error: str) -> dict:
         """Called by a caller that failed to reach the actor's worker."""

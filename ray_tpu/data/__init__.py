@@ -1,0 +1,40 @@
+"""ray_tpu.data — distributed data loading/processing (reference: ray.data).
+
+Lazy block-based datasets over the shared-memory object store; per-block
+ops fuse into single tasks; `iter_jax_batches` is the TPU ingest path.
+"""
+
+from ray_tpu.data.block import Block
+from ray_tpu.data.dataset import Dataset, GroupedData
+from ray_tpu.data.read_api import (
+    from_arrow,
+    from_blocks,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    range_tensor,
+    read_csv,
+    read_json,
+    read_numpy,
+    read_parquet,
+    read_text,
+)
+
+__all__ = [
+    "Block",
+    "Dataset",
+    "GroupedData",
+    "from_arrow",
+    "from_blocks",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "range_tensor",
+    "read_csv",
+    "read_json",
+    "read_numpy",
+    "read_parquet",
+    "read_text",
+]

@@ -1,0 +1,64 @@
+"""Streaming plan executor over ray_tpu tasks.
+
+Reference architecture: python/ray/data/_internal/execution/
+streaming_executor.py:100 — operators pull upstream lazily, blocks flow
+as ObjectRefs, bounded in-flight tasks give backpressure. This is a
+compact equivalent: each logical op maps block-refs → block-refs via
+remote tasks with a sliding window (no materialize-the-world stages).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Iterator, List, Optional
+
+import ray_tpu
+from ray_tpu.data.block import Block
+
+# Max concurrently-running block tasks per op (backpressure window;
+# reference: backpressure_policy/concurrency_cap_backpressure_policy.py).
+DEFAULT_CONCURRENCY = 16
+
+
+@ray_tpu.remote
+def _apply_block_fn(fn_bytes: bytes, *blocks: Block) -> Any:
+    from ray_tpu._private.serialization import loads_function
+
+    fn = loads_function(fn_bytes)
+    return fn(*blocks)
+
+
+def _pack(fn: Callable) -> bytes:
+    from ray_tpu._private.serialization import dumps_function
+
+    return dumps_function(fn)
+
+
+class Executor:
+    """Maps block refs through per-block remote tasks with a bounded
+    in-flight window, yielding result refs in order as they finish."""
+
+    def __init__(self, concurrency: int = DEFAULT_CONCURRENCY):
+        self.concurrency = concurrency
+
+    def map_refs(
+        self,
+        fn: Callable[[Block], Block],
+        refs: Iterator[Any],
+        local: bool = False,
+    ) -> Iterator[Any]:
+        """Lazily apply fn to each block ref. `local=True` short-circuits
+        through the driver (tiny plans, local mode)."""
+        if local:
+            for r in refs:
+                blk = ray_tpu.get(r) if hasattr(r, "id") else r
+                yield ray_tpu.put(fn(blk))
+            return
+        fn_b = _pack(fn)
+        window: List[Any] = []
+        for r in refs:
+            window.append(_apply_block_fn.remote(fn_b, r))
+            if len(window) >= self.concurrency:
+                yield window.pop(0)
+        while window:
+            yield window.pop(0)

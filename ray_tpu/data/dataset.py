@@ -1,0 +1,432 @@
+"""Dataset — lazy, distributed, blocks-over-object-store data plane.
+
+Reference: python/ray/data/dataset.py:202 (`Dataset`), lazy logical plan
+(_internal/logical/), streaming execution (streaming_executor.py:100).
+
+Design here: a Dataset is (source block refs, chain of logical ops).
+Consecutive per-block ops FUSE into one remote task per block (the
+reference planner's map-fusion); all-to-all ops (repartition, shuffle,
+sort, groupby) are barriers. Blocks are dicts of numpy arrays riding the
+shared-memory object store; `iter_batches` feeds jax/TPU input pipelines
+without copies.
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Union
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import (
+    Block,
+    block_concat,
+    block_from_rows,
+    block_num_rows,
+    block_size_bytes,
+    block_slice,
+    block_take,
+    block_to_rows,
+    normalize_batch,
+    to_batch_format,
+)
+from ray_tpu.data._internal.executor import Executor
+
+
+# ---------------------------------------------------------------------------
+# Logical ops
+# ---------------------------------------------------------------------------
+class _Op:
+    pass
+
+
+class _MapBlocks(_Op):
+    """Per-block transform (map/map_batches/filter/flat_map fuse here)."""
+
+    def __init__(self, fn: Callable[[Block], Block], name: str):
+        self.fn = fn
+        self.name = name
+
+
+class _AllToAll(_Op):
+    """Barrier op: takes ALL input blocks, returns new blocks."""
+
+    def __init__(self, fn: Callable[[List[Block]], List[Block]], name: str):
+        self.fn = fn
+        self.name = name
+
+
+class _Limit(_Op):
+    def __init__(self, n: int):
+        self.n = n
+
+
+class Dataset:
+    """Lazy distributed dataset (reference: data/dataset.py:202)."""
+
+    def __init__(self, block_refs: List[Any], ops: Optional[List[_Op]] = None):
+        self._source_refs = list(block_refs)
+        self._ops: List[_Op] = list(ops or [])
+        self._executor = Executor()
+
+    # -- plan building ------------------------------------------------
+    def _with(self, op: _Op) -> "Dataset":
+        return Dataset(self._source_refs, self._ops + [op])
+
+    def map_batches(
+        self,
+        fn: Callable,
+        *,
+        batch_format: Optional[str] = None,
+        batch_size: Optional[int] = None,
+        fn_kwargs: Optional[Dict] = None,
+        **_ignored,
+    ) -> "Dataset":
+        """Apply fn to batches (reference: dataset.py:531). With
+        batch_size=None the whole block is one batch (fastest on TPU —
+        blocks are already sized for the store)."""
+        kw = fn_kwargs or {}
+
+        def _apply(block: Block) -> Block:
+            if not block_num_rows(block):
+                return block
+            if batch_size is None:
+                return normalize_batch(fn(to_batch_format(block, batch_format), **kw))
+            outs = []
+            n = block_num_rows(block)
+            for s in range(0, n, batch_size):
+                piece = block_slice(block, s, min(s + batch_size, n))
+                outs.append(normalize_batch(fn(to_batch_format(piece, batch_format), **kw)))
+            return block_concat(outs)
+
+        return self._with(_MapBlocks(_apply, f"MapBatches({getattr(fn, '__name__', 'fn')})"))
+
+    def map(self, fn: Callable) -> "Dataset":
+        def _apply(block: Block) -> Block:
+            return block_from_rows([fn(r) for r in block_to_rows(block)])
+
+        return self._with(_MapBlocks(_apply, "Map"))
+
+    def flat_map(self, fn: Callable) -> "Dataset":
+        def _apply(block: Block) -> Block:
+            out = []
+            for r in block_to_rows(block):
+                out.extend(fn(r))
+            return block_from_rows(out)
+
+        return self._with(_MapBlocks(_apply, "FlatMap"))
+
+    def filter(self, fn: Callable) -> "Dataset":
+        def _apply(block: Block) -> Block:
+            return block_from_rows([r for r in block_to_rows(block) if fn(r)])
+
+        return self._with(_MapBlocks(_apply, "Filter"))
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        return self._with(_MapBlocks(lambda b: {k: b[k] for k in cols}, "Select"))
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        return self._with(
+            _MapBlocks(lambda b: {k: v for k, v in b.items() if k not in cols}, "Drop")
+        )
+
+    def add_column(self, name: str, fn: Callable[[Block], np.ndarray]) -> "Dataset":
+        def _apply(block: Block) -> Block:
+            out = dict(block)
+            out[name] = np.asarray(fn(block))
+            return out
+
+        return self._with(_MapBlocks(_apply, f"AddColumn({name})"))
+
+    def limit(self, n: int) -> "Dataset":
+        return self._with(_Limit(n))
+
+    # -- all-to-all ----------------------------------------------------
+    def repartition(self, num_blocks: int) -> "Dataset":
+        def _repart(blocks: List[Block]) -> List[Block]:
+            whole = block_concat(blocks)
+            n = block_num_rows(whole)
+            if n == 0:
+                return []
+            splits = np.array_split(np.arange(n), num_blocks)
+            return [block_take(whole, idx) for idx in splits if len(idx)]
+
+        return self._with(_AllToAll(_repart, f"Repartition({num_blocks})"))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        def _shuf(blocks: List[Block]) -> List[Block]:
+            whole = block_concat(blocks)
+            n = block_num_rows(whole)
+            if n == 0:
+                return []
+            rng = np.random.RandomState(seed)
+            perm = rng.permutation(n)
+            k = max(1, len(blocks))
+            return [block_take(whole, idx) for idx in np.array_split(perm, k)]
+
+        return self._with(_AllToAll(_shuf, "RandomShuffle"))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        def _sort(blocks: List[Block]) -> List[Block]:
+            whole = block_concat(blocks)
+            if not block_num_rows(whole):
+                return []
+            order = np.argsort(whole[key], kind="stable")
+            if descending:
+                order = order[::-1]
+            k = max(1, len(blocks))
+            return [block_take(whole, idx) for idx in np.array_split(order, k)]
+
+        return self._with(_AllToAll(_sort, f"Sort({key})"))
+
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        refs = list(self._iter_output_refs())
+        for o in others:
+            refs.extend(o._iter_output_refs())
+        return Dataset(refs)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        a = self.materialize_block()
+        b = other.materialize_block()
+        merged = dict(a)
+        for k, v in b.items():
+            merged[k if k not in merged else f"{k}_1"] = v
+        return Dataset([ray_tpu.put(merged)])
+
+    # -- execution -----------------------------------------------------
+    def _iter_output_refs(self) -> Iterator[Any]:
+        """Execute the plan, yielding output block refs streamingly.
+        Consecutive _MapBlocks fuse into one task per block."""
+        refs: Iterator[Any] = iter(self._source_refs)
+        i = 0
+        ops = self._ops
+        local = _use_local_exec()
+        while i < len(ops):
+            op = ops[i]
+            if isinstance(op, _MapBlocks):
+                fused = [op.fn]
+                j = i + 1
+                while j < len(ops) and isinstance(ops[j], _MapBlocks):
+                    fused.append(ops[j].fn)
+                    j += 1
+
+                def chain(block, fns=tuple(fused)):
+                    for f in fns:
+                        block = f(block)
+                    return block
+
+                refs = self._executor.map_refs(chain, refs, local=local)
+                i = j
+            elif isinstance(op, _AllToAll):
+                blocks = [ray_tpu.get(r) for r in refs]
+                out_blocks = op.fn(blocks)
+                refs = iter([ray_tpu.put(b) for b in out_blocks])
+                i += 1
+            elif isinstance(op, _Limit):
+                refs = _limit_refs(refs, op.n)
+                i += 1
+            else:
+                raise TypeError(op)
+        return refs
+
+    def iter_blocks(self) -> Iterator[Block]:
+        for r in self._iter_output_refs():
+            yield ray_tpu.get(r)
+
+    def iter_rows(self) -> Iterator[Any]:
+        for b in self.iter_blocks():
+            yield from block_to_rows(b)
+
+    def iter_batches(
+        self,
+        *,
+        batch_size: Optional[int] = 256,
+        batch_format: Optional[str] = None,
+        drop_last: bool = False,
+        local_shuffle_buffer_size: Optional[int] = None,
+        local_shuffle_seed: Optional[int] = None,
+    ) -> Iterator[Any]:
+        """Re-batch the block stream to batch_size (reference:
+        dataset.py:5981). The carry-over path avoids concatenating more
+        than one pending block at a time."""
+        rng = np.random.RandomState(local_shuffle_seed)
+        carry: Block = {}
+        for block in self.iter_blocks():
+            if local_shuffle_buffer_size:
+                n = block_num_rows(block)
+                if n:
+                    block = block_take(block, rng.permutation(n))
+            carry = block_concat([carry, block]) if carry else block
+            if batch_size is None:
+                if block_num_rows(carry):
+                    yield to_batch_format(carry, batch_format)
+                carry = {}
+                continue
+            while block_num_rows(carry) >= batch_size:
+                yield to_batch_format(block_slice(carry, 0, batch_size), batch_format)
+                carry = block_slice(carry, batch_size, block_num_rows(carry))
+        if block_num_rows(carry) and not drop_last and batch_size is not None:
+            yield to_batch_format(carry, batch_format)
+
+    def iter_jax_batches(self, *, batch_size: int = 256, sharding=None,
+                         drop_last: bool = True) -> Iterator[Any]:
+        """TPU ingest: yields dicts of jax arrays, device_put with the
+        given sharding (the Train ingest path — no reference equivalent;
+        torch iterators are replaced by this)."""
+        import jax
+
+        for batch in self.iter_batches(batch_size=batch_size, drop_last=drop_last):
+            if sharding is not None:
+                yield {k: jax.device_put(v, sharding) for k, v in batch.items()}
+            else:
+                yield {k: jax.numpy.asarray(v) for k, v in batch.items()}
+
+    # -- consumption ---------------------------------------------------
+    def take(self, n: int = 20) -> List[Any]:
+        out = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[Any]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        return sum(block_num_rows(b) for b in self.iter_blocks())
+
+    def sum(self, col: str) -> float:
+        return float(np.sum([b[col].sum() for b in self.iter_blocks() if block_num_rows(b)]))
+
+    def min(self, col: str) -> float:
+        return float(np.min([b[col].min() for b in self.iter_blocks() if block_num_rows(b)]))
+
+    def max(self, col: str) -> float:
+        return float(np.max([b[col].max() for b in self.iter_blocks() if block_num_rows(b)]))
+
+    def mean(self, col: str) -> float:
+        tot, cnt = 0.0, 0
+        for b in self.iter_blocks():
+            n = block_num_rows(b)
+            if n:
+                tot += float(b[col].sum())
+                cnt += n
+        return tot / max(cnt, 1)
+
+    def schema(self) -> Dict[str, Any]:
+        for b in self.iter_blocks():
+            if block_num_rows(b):
+                return {k: (v.dtype, v.shape[1:]) for k, v in b.items()}
+        return {}
+
+    def num_blocks(self) -> int:
+        return sum(1 for _ in self._iter_output_refs())
+
+    def size_bytes(self) -> int:
+        return sum(block_size_bytes(b) for b in self.iter_blocks())
+
+    def materialize(self) -> "Dataset":
+        """Execute the plan; result holds concrete block refs."""
+        return Dataset(list(self._iter_output_refs()))
+
+    def materialize_block(self) -> Block:
+        return block_concat(list(self.iter_blocks()))
+
+    def split(self, n: int, *, locality_hints=None) -> List["Dataset"]:
+        """Split into n datasets (reference: dataset.py split for per-worker
+        ingest shards)."""
+        refs = list(self._iter_output_refs())
+        if len(refs) < n:
+            whole = block_concat([ray_tpu.get(r) for r in refs])
+            rows = block_num_rows(whole)
+            idx = np.array_split(np.arange(rows), n)
+            return [Dataset([ray_tpu.put(block_take(whole, i))]) for i in idx]
+        parts = np.array_split(np.arange(len(refs)), n)
+        return [Dataset([refs[i] for i in p]) for p in parts]
+
+    def train_test_split(self, test_size: float, *, shuffle: bool = False,
+                         seed: Optional[int] = None) -> Tuple["Dataset", "Dataset"]:
+        whole = self.materialize_block()
+        n = block_num_rows(whole)
+        idx = np.arange(n)
+        if shuffle:
+            np.random.RandomState(seed).shuffle(idx)
+        k = int(n * (1 - test_size))
+        return (
+            Dataset([ray_tpu.put(block_take(whole, idx[:k]))]),
+            Dataset([ray_tpu.put(block_take(whole, idx[k:]))]),
+        )
+
+    def __repr__(self) -> str:
+        names = [getattr(op, "name", type(op).__name__) for op in self._ops]
+        return f"Dataset(blocks={len(self._source_refs)}, plan={' -> '.join(names) or 'source'})"
+
+    stats = __repr__
+
+
+class GroupedData:
+    """Sort-based groupby (reference: data grouped_data.py)."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _agg(self, agg_fn: Callable[[Block], Dict[str, Any]], suffix: str) -> Dataset:
+        whole = self._ds.materialize_block()
+        if not block_num_rows(whole):
+            return Dataset([])
+        keys = whole[self._key]
+        uniq, inverse = np.unique(keys, return_inverse=True)
+        rows = []
+        for gi, kv in enumerate(uniq):
+            grp = block_take(whole, np.where(inverse == gi)[0])
+            row = {self._key: kv}
+            row.update(agg_fn(grp))
+            rows.append(row)
+        return Dataset([ray_tpu.put(block_from_rows(rows))])
+
+    def count(self) -> Dataset:
+        return self._agg(lambda g: {"count()": block_num_rows(g)}, "count")
+
+    def sum(self, col: str) -> Dataset:
+        return self._agg(lambda g: {f"sum({col})": g[col].sum()}, "sum")
+
+    def mean(self, col: str) -> Dataset:
+        return self._agg(lambda g: {f"mean({col})": g[col].mean()}, "mean")
+
+    def max(self, col: str) -> Dataset:
+        return self._agg(lambda g: {f"max({col})": g[col].max()}, "max")
+
+    def min(self, col: str) -> Dataset:
+        return self._agg(lambda g: {f"min({col})": g[col].min()}, "min")
+
+
+def _limit_refs(refs: Iterator[Any], n: int) -> Iterator[Any]:
+    remaining = n
+    for r in refs:
+        if remaining <= 0:
+            return
+        block = ray_tpu.get(r)
+        rows = block_num_rows(block)
+        if rows <= remaining:
+            remaining -= rows
+            yield r
+        else:
+            yield ray_tpu.put(block_slice(block, 0, remaining))
+            remaining = 0
+
+
+def _use_local_exec() -> bool:
+    """Local mode (or no cluster) executes the plan in-process."""
+    from ray_tpu._private import worker as wm
+
+    w = wm.global_worker
+    if w is None or not w.connected:
+        return True
+    return getattr(w, "mode", None) == wm.LOCAL_MODE

@@ -1,0 +1,19 @@
+"""ray_tpu.rllib — reinforcement learning (reference: rllib/).
+
+PPO with CPU env-runner actors + a jitted JAX learner; built-in
+gymnasium-compatible env API (numpy CartPole included).
+"""
+
+from ray_tpu.rllib.env import CartPole, Env, make_env, register_env
+from ray_tpu.rllib.ppo import PPO, PPOConfig, PPOLearner, compute_gae
+
+__all__ = [
+    "CartPole",
+    "Env",
+    "PPO",
+    "PPOConfig",
+    "PPOLearner",
+    "compute_gae",
+    "make_env",
+    "register_env",
+]

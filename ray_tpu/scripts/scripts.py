@@ -1,0 +1,179 @@
+"""CLI — cluster lifecycle (reference: python/ray/scripts/scripts.py —
+`ray start` :800, `stop` :1341, `status`, `submit` :1976).
+
+Usage:
+    python -m ray_tpu.scripts.scripts start --head [--num-cpus N] [--num-tpus N]
+    python -m ray_tpu.scripts.scripts start --address HOST:PORT
+    python -m ray_tpu.scripts.scripts status [--address HOST:PORT]
+    python -m ray_tpu.scripts.scripts stop
+    python -m ray_tpu.scripts.scripts submit SCRIPT [args...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Optional
+
+STATE_FILE = os.path.join(tempfile.gettempdir(), "ray_tpu_cluster.json")
+
+
+def _write_state(state: dict) -> None:
+    with open(STATE_FILE, "w") as f:
+        json.dump(state, f)
+
+
+def _read_state() -> Optional[dict]:
+    if not os.path.exists(STATE_FILE):
+        return None
+    with open(STATE_FILE) as f:
+        return json.load(f)
+
+
+def cmd_start(args) -> int:
+    from ray_tpu._private.node import Node, default_node_resources
+
+    if args.head:
+        import atexit
+
+        node = Node(
+            num_cpus=args.num_cpus,
+            num_tpus=args.num_tpus,
+        )
+        node.start()
+        # CLI-started clusters outlive the CLI process (reference:
+        # `ray start` daemonizes) — drop the auto-stop hook
+        atexit.unregister(node.stop)
+        addr = f"{node.gcs_addr[0]}:{node.gcs_addr[1]}"
+        _write_state(
+            {
+                "address": addr,
+                "gcs_pid": node.gcs_proc.pid,
+                "raylet_pids": [node.raylet_proc.pid],
+                "session_dir": node.session_dir,
+            }
+        )
+        print(f"ray_tpu head started.\n  address: {addr}")
+        print(f"  connect with: ray_tpu.init(address='{addr}')")
+        return 0
+
+    if not args.address:
+        print("either --head or --address required", file=sys.stderr)
+        return 1
+    # worker node: start a raylet that joins the existing GCS
+    from ray_tpu._private.config import config
+    from ray_tpu._private.ids import NodeID
+
+    session_dir = tempfile.mkdtemp(prefix="ray_tpu_worker_")
+    store_socket = os.path.join(session_dir, "store.sock")
+    resources = default_node_resources(args.num_cpus, args.num_tpus, None)
+    port_file = os.path.join(session_dir, "raylet_port")
+    env = dict(os.environ)
+    env["RAY_TPU_CONFIG_JSON"] = config.to_json()
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = os.pathsep.join(p for p in [repo_root, env.get("PYTHONPATH", "")] if p)
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "ray_tpu._private.raylet.raylet",
+            "--node-id", NodeID.from_random().hex(),
+            "--gcs-addr", args.address,
+            "--resources-json", json.dumps(resources),
+            "--store-socket", store_socket,
+            "--store-capacity", str(config.object_store_memory_bytes),
+            "--session-dir", session_dir,
+            "--port-file", port_file,
+        ],
+        env=env,
+        stdout=open(os.path.join(session_dir, "raylet.log"), "ab"),
+        stderr=subprocess.STDOUT,
+    )
+    deadline = time.monotonic() + 30
+    while not os.path.exists(port_file) and time.monotonic() < deadline:
+        if proc.poll() is not None:
+            print(f"raylet exited (see {session_dir}/raylet.log)", file=sys.stderr)
+            return 1
+        time.sleep(0.05)
+    state = _read_state() or {"address": args.address, "raylet_pids": []}
+    state.setdefault("raylet_pids", []).append(proc.pid)
+    _write_state(state)
+    print(f"worker raylet joined {args.address} (pid {proc.pid})")
+    return 0
+
+
+def cmd_stop(_args) -> int:
+    state = _read_state()
+    n = 0
+    if state:
+        for pid in state.get("raylet_pids", []) + [state.get("gcs_pid")]:
+            if pid:
+                try:
+                    os.kill(pid, signal.SIGTERM)
+                    n += 1
+                except ProcessLookupError:
+                    pass
+        os.remove(STATE_FILE)
+    print(f"stopped {n} processes")
+    return 0
+
+
+def cmd_status(args) -> int:
+    import ray_tpu
+    from ray_tpu.util import state as state_api
+
+    address = args.address or (_read_state() or {}).get("address")
+    if not address:
+        print("no running cluster found", file=sys.stderr)
+        return 1
+    ray_tpu.init(address=address, ignore_reinit_error=True)
+    summary = state_api.cluster_summary()
+    print(json.dumps(summary, indent=2, default=str))
+    ray_tpu.shutdown()
+    return 0
+
+
+def cmd_submit(args) -> int:
+    """Run a script with the cluster address exported (reference: `ray
+    submit`; full job-server submission lives in ray_tpu.job)."""
+    address = args.address or (_read_state() or {}).get("address")
+    env = dict(os.environ)
+    if address:
+        env["RAY_TPU_ADDRESS"] = address
+    return subprocess.call([sys.executable, args.script] + args.script_args, env=env)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ray-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="start head or worker node processes")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", default=None, help="GCS host:port to join")
+    sp.add_argument("--num-cpus", type=float, default=None)
+    sp.add_argument("--num-tpus", type=float, default=None)
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop processes started by this CLI")
+    sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("status", help="print cluster summary")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("submit", help="run a script against the cluster")
+    sp.add_argument("script")
+    sp.add_argument("script_args", nargs="*")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_submit)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

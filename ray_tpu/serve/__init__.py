@@ -1,0 +1,39 @@
+"""ray_tpu.serve — model serving (reference: python/ray/serve).
+
+Deployments are replicated actors; handles route with power-of-two-
+choices; @serve.batch keeps TPU batches full; a stdlib HTTP proxy
+provides ingress.
+"""
+
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.controller import (
+    delete,
+    get_app_handle,
+    run,
+    shutdown,
+    status,
+)
+from ray_tpu.serve.deployment import (
+    Application,
+    Deployment,
+    DeploymentHandle,
+    DeploymentResponse,
+    deployment,
+)
+from ray_tpu.serve.http_proxy import start_http_proxy, stop_http_proxy
+
+__all__ = [
+    "Application",
+    "Deployment",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "batch",
+    "delete",
+    "deployment",
+    "get_app_handle",
+    "run",
+    "shutdown",
+    "start_http_proxy",
+    "status",
+    "stop_http_proxy",
+]

@@ -1,0 +1,191 @@
+"""Serve control plane: controller actor + replica actors + HTTP proxy.
+
+Reference: ServeController (serve/_private/controller.py:127) reconciles
+DeploymentState (deployment_state.py:2820); replicas are plain actors
+(replica.py:1554); ProxyActor serves HTTP ingress (proxy.py:1098).
+
+TPU notes: replicas request TPU resources through normal actor options —
+scheduling is the raylet's chip accounting; batching (serve/batching.py
+here) is what keeps the MXU busy.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.serve.deployment import (
+    Application,
+    Deployment,
+    DeploymentHandle,
+    _ReplicaSet,
+)
+
+CONTROLLER_NAME = "__serve_controller"
+
+
+@ray_tpu.remote
+class Replica:
+    """Hosts one copy of the deployment callable (reference:
+    serve/_private/replica.py:1554 handle_request)."""
+
+    def __init__(self, serialized_target: bytes, init_args, init_kwargs,
+                 user_config: Optional[Dict] = None):
+        from ray_tpu._private.serialization import loads_function
+
+        target = loads_function(serialized_target)
+        if isinstance(target, type):
+            self._callable = target(*init_args, **init_kwargs)
+        else:
+            self._callable = target
+        if user_config is not None and hasattr(self._callable, "reconfigure"):
+            self._callable.reconfigure(user_config)
+
+    def handle_request(self, method: str, args, kwargs):
+        if method == "__call__":
+            return self._callable(*args, **kwargs)
+        return getattr(self._callable, method)(*args, **kwargs)
+
+    def reconfigure(self, user_config: Dict) -> bool:
+        if hasattr(self._callable, "reconfigure"):
+            self._callable.reconfigure(user_config)
+        return True
+
+    def health_check(self) -> bool:
+        return True
+
+
+@ray_tpu.remote
+class ServeController:
+    """Reference: controller.py:127 — owns deployment → replica-actor map."""
+
+    def __init__(self):
+        self._deployments: Dict[str, Dict[str, Any]] = {}
+
+    def deploy(self, name: str, serialized_target: bytes, init_args, init_kwargs,
+               num_replicas: int, max_ongoing_requests: int,
+               actor_options: Dict[str, Any], user_config: Optional[Dict]) -> List[Any]:
+        existing = self._deployments.get(name)
+        if existing:
+            for a in existing["replicas"]:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
+        replicas = [
+            Replica.options(
+                name=f"__serve_{name}_replica_{i}",
+                max_concurrency=max(2, max_ongoing_requests),
+                num_cpus=actor_options.get("num_cpus", 1),
+                num_tpus=actor_options.get("num_tpus", 0),
+                resources=actor_options.get("resources"),
+            ).remote(serialized_target, init_args, init_kwargs, user_config)
+            for i in range(num_replicas)
+        ]
+        # block until constructed so serve.run returns a live app
+        ray_tpu.get([r.health_check.remote() for r in replicas])
+        self._deployments[name] = {
+            "replicas": replicas,
+            "max_ongoing_requests": max_ongoing_requests,
+            "num_replicas": num_replicas,
+        }
+        return replicas
+
+    def get_deployment(self, name: str) -> Optional[Dict[str, Any]]:
+        d = self._deployments.get(name)
+        if d is None:
+            return None
+        return {"replicas": d["replicas"], "max_ongoing_requests": d["max_ongoing_requests"]}
+
+    def list_deployments(self) -> List[str]:
+        return list(self._deployments)
+
+    def delete(self, name: str) -> bool:
+        d = self._deployments.pop(name, None)
+        if d:
+            for a in d["replicas"]:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
+        return d is not None
+
+    def shutdown(self) -> bool:
+        for name in list(self._deployments):
+            self.delete(name)
+        return True
+
+
+# ---------------------------------------------------------------------------
+# Module-level client API (reference: serve/api.py)
+# ---------------------------------------------------------------------------
+_state = threading.local()
+
+
+def _controller():
+    ctl = getattr(_state, "controller", None)
+    if ctl is None:
+        try:
+            ctl = ray_tpu.get_actor(CONTROLLER_NAME)
+        except Exception:
+            ctl = ServeController.options(name=CONTROLLER_NAME, get_if_exists=True).remote()
+        _state.controller = ctl
+    return ctl
+
+
+def run(app: Application, *, name: Optional[str] = None,
+        route_prefix: Optional[str] = None, **_ignored) -> DeploymentHandle:
+    """Deploy the application; returns a handle (reference: serve.run
+    api.py:930)."""
+    from ray_tpu._private.serialization import dumps_function
+
+    dep: Deployment = app.deployment
+    cfg = dep._config
+    ctl = _controller()
+    replicas = ray_tpu.get(
+        ctl.deploy.remote(
+            cfg.name,
+            dumps_function(dep._target),
+            app.init_args,
+            app.init_kwargs,
+            cfg.num_replicas,
+            cfg.max_ongoing_requests,
+            cfg.ray_actor_options,
+            cfg.user_config,
+        )
+    )
+    rs = _ReplicaSet(replicas, cfg.max_ongoing_requests)
+    return DeploymentHandle(cfg.name, rs)
+
+
+def get_app_handle(name: str) -> DeploymentHandle:
+    ctl = _controller()
+    info = ray_tpu.get(ctl.get_deployment.remote(name))
+    if info is None:
+        raise ValueError(f"No deployment named {name!r}")
+    return DeploymentHandle(name, _ReplicaSet(info["replicas"], info["max_ongoing_requests"]))
+
+
+def delete(name: str) -> None:
+    ray_tpu.get(_controller().delete.remote(name))
+
+
+def shutdown() -> None:
+    ctl = getattr(_state, "controller", None)
+    try:
+        ctl = ctl or ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        return
+    try:
+        ray_tpu.get(ctl.shutdown.remote())
+        ray_tpu.kill(ctl)
+    except Exception:
+        pass
+    _state.controller = None
+
+
+def status() -> Dict[str, Any]:
+    ctl = _controller()
+    return {"deployments": ray_tpu.get(ctl.list_deployments.remote())}

@@ -1,0 +1,161 @@
+"""Deployment decorator + handle (reference: serve/api.py @serve.deployment,
+serve/handle.py DeploymentHandle).
+
+A deployment is a replicated actor class; the handle routes calls to
+replicas with power-of-two-choices on outstanding requests (reference:
+request_router/pow_2_router.py:27) tracked caller-side.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+
+
+@dataclasses.dataclass
+class DeploymentConfig:
+    name: str
+    num_replicas: int = 1
+    max_ongoing_requests: int = 16
+    ray_actor_options: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    user_config: Optional[Dict[str, Any]] = None
+
+
+class Deployment:
+    """Result of @serve.deployment on a class/function; `.bind(*args)`
+    produces an Application to pass to serve.run (reference: DAG-style
+    app building, serve/api.py)."""
+
+    def __init__(self, target: Any, config: DeploymentConfig):
+        self._target = target
+        self._config = config
+
+    @property
+    def name(self) -> str:
+        return self._config.name
+
+    def options(self, **kwargs) -> "Deployment":
+        cfg = dataclasses.replace(self._config)
+        for k, v in kwargs.items():
+            if k == "name":
+                cfg.name = v
+            elif k == "num_replicas":
+                cfg.num_replicas = v
+            elif k == "max_ongoing_requests":
+                cfg.max_ongoing_requests = v
+            elif k == "ray_actor_options":
+                cfg.ray_actor_options = v
+            elif k == "user_config":
+                cfg.user_config = v
+            else:
+                raise ValueError(f"Unknown deployment option {k}")
+        return Deployment(self._target, cfg)
+
+    def bind(self, *args, **kwargs) -> "Application":
+        return Application(self, args, kwargs)
+
+
+class Application:
+    def __init__(self, deployment: Deployment, init_args, init_kwargs):
+        self.deployment = deployment
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs
+
+
+def deployment(_target=None, *, name: Optional[str] = None, num_replicas: int = 1,
+               max_ongoing_requests: int = 16,
+               ray_actor_options: Optional[Dict[str, Any]] = None,
+               user_config: Optional[Dict[str, Any]] = None, **_ignored):
+    """@serve.deployment (reference: serve/api.py)."""
+
+    def deco(target):
+        cfg = DeploymentConfig(
+            name=name or getattr(target, "__name__", "deployment"),
+            num_replicas=num_replicas,
+            max_ongoing_requests=max_ongoing_requests,
+            ray_actor_options=ray_actor_options or {},
+            user_config=user_config,
+        )
+        return Deployment(target, cfg)
+
+    return deco(_target) if _target is not None else deco
+
+
+class _ReplicaSet:
+    """Caller-side routing state for one deployment."""
+
+    def __init__(self, actors: List[Any], max_ongoing: int):
+        self.actors = list(actors)
+        self.max_ongoing = max_ongoing
+        self.outstanding = [0] * len(actors)
+        self.lock = threading.Lock()
+
+    def pick(self) -> int:
+        """Power-of-two-choices by outstanding count
+        (reference: pow_2_router.py:27)."""
+        with self.lock:
+            n = len(self.actors)
+            if n == 1:
+                idx = 0
+            else:
+                i, j = random.sample(range(n), 2)
+                idx = i if self.outstanding[i] <= self.outstanding[j] else j
+            self.outstanding[idx] += 1
+            return idx
+
+    def release(self, idx: int) -> None:
+        with self.lock:
+            self.outstanding[idx] -= 1
+
+
+class DeploymentResponse:
+    """Future-like result (reference: handle.py DeploymentResponse)."""
+
+    def __init__(self, ref, on_done: Callable[[], None]):
+        self._ref = ref
+        self._on_done = on_done
+        self._done = False
+
+    def result(self, timeout: Optional[float] = None):
+        try:
+            return ray_tpu.get(self._ref, timeout=timeout)
+        finally:
+            if not self._done:
+                self._done = True
+                self._on_done()
+
+    def _to_object_ref(self):
+        return self._ref
+
+
+class DeploymentHandle:
+    """Reference: serve/handle.py:1041. handle.method.remote(args) →
+    DeploymentResponse; plain handle.remote() calls __call__."""
+
+    def __init__(self, name: str, replica_set: _ReplicaSet):
+        self._name = name
+        self._rs = replica_set
+
+    def __getattr__(self, method: str) -> "_HandleMethod":
+        if method.startswith("_"):
+            raise AttributeError(method)
+        return _HandleMethod(self._rs, method)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return _HandleMethod(self._rs, "__call__").remote(*args, **kwargs)
+
+
+class _HandleMethod:
+    def __init__(self, rs: _ReplicaSet, method: str):
+        self._rs = rs
+        self._method = method
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        idx = self._rs.pick()
+        actor = self._rs.actors[idx]
+        ref = getattr(actor, "handle_request").remote(self._method, args, kwargs)
+        return DeploymentResponse(ref, on_done=lambda: self._rs.release(idx))

@@ -1,0 +1,80 @@
+"""HTTP ingress for Serve deployments.
+
+Reference: per-node ProxyActor ASGI app (serve/_private/proxy.py:1098,
+uvicorn/starlette). Here: a stdlib ThreadingHTTPServer that maps
+``POST /<deployment>`` with a JSON body to ``handle.remote(body)`` —
+dependency-free, good for the control path; heavy payloads should use
+handles directly (they ride the shared-memory object store).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+from ray_tpu.serve.controller import get_app_handle
+from ray_tpu.serve.deployment import DeploymentHandle
+
+
+class _Proxy:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self.handles: Dict[str, DeploymentHandle] = {}
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_POST(self):
+                name = self.path.strip("/").split("/")[0]
+                try:
+                    handle = proxy.handles.get(name)
+                    if handle is None:
+                        handle = get_app_handle(name)
+                        proxy.handles[name] = handle
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = self.rfile.read(length)
+                    payload = json.loads(body) if body else None
+                    out = handle.remote(payload).result(timeout=60)
+                    data = json.dumps({"result": out}).encode()
+                    self.send_response(200)
+                except ValueError as e:
+                    data = json.dumps({"error": str(e)}).encode()
+                    self.send_response(404)
+                except Exception as e:  # noqa: BLE001
+                    data = json.dumps({"error": str(e)}).encode()
+                    self.send_response(500)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            do_GET = do_POST
+
+        self.server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.server.server_address[1]
+        self._thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self.server.shutdown()
+
+
+_proxy: Optional[_Proxy] = None
+
+
+def start_http_proxy(host: str = "127.0.0.1", port: int = 8000) -> int:
+    """Start the ingress; returns the bound port."""
+    global _proxy
+    if _proxy is None:
+        _proxy = _Proxy(host, port)
+    return _proxy.port
+
+
+def stop_http_proxy() -> None:
+    global _proxy
+    if _proxy is not None:
+        _proxy.stop()
+        _proxy = None

@@ -54,7 +54,10 @@ def _run_worker_loop(
             Checkpoint(latest_checkpoint_path) if latest_checkpoint_path else None
         ),
     )
-    _set_session(ctx)
+    from ray_tpu.train.session import _session as _session_tls
+
+    prev_ctx = getattr(_session_tls, "ctx", None)  # restore outer session
+    _set_session(ctx)                               # (Train-in-Tune nesting)
     error = None
     try:
         if config is not None:
@@ -64,7 +67,7 @@ def _run_worker_loop(
     except BaseException as e:  # reported to the controller, not raised here
         error = "".join(traceback.format_exception(type(e), e, e.__traceback__))
     finally:
-        _set_session(None)
+        _set_session(prev_ctx)
     reports: List[Dict[str, Any]] = []
     while not ctx._report_queue.empty():
         reports.append(ctx._report_queue.get())

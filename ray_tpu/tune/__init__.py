@@ -1,0 +1,34 @@
+"""ray_tpu.tune — hyperparameter tuning (reference: python/ray/tune).
+
+Tuner runs trial actors under the normal scheduler (TPU resources work
+unchanged); searchers expand grid/random spaces; ASHA/median-stopping
+schedulers stop weak trials early.
+"""
+
+from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler, MedianStoppingRule
+from ray_tpu.tune.search import (
+    choice,
+    grid_search,
+    loguniform,
+    quniform,
+    randint,
+    uniform,
+)
+from ray_tpu.tune.tuner import ResultGrid, TrialResult, TuneConfig, Tuner, report
+
+__all__ = [
+    "ASHAScheduler",
+    "FIFOScheduler",
+    "MedianStoppingRule",
+    "ResultGrid",
+    "TrialResult",
+    "TuneConfig",
+    "Tuner",
+    "choice",
+    "grid_search",
+    "loguniform",
+    "quniform",
+    "randint",
+    "report",
+    "uniform",
+]

@@ -1,0 +1,105 @@
+"""Trial schedulers (reference: python/ray/tune/schedulers/).
+
+FIFO runs everything to completion; ASHA (async successive halving,
+reference async_hyperband.py) stops under-performing trials at rung
+boundaries so the budget concentrates on the best configs — the key
+scheduler for expensive TPU trials.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        return CONTINUE
+
+
+class ASHAScheduler:
+    """Async Successive Halving (reference:
+    tune/schedulers/async_hyperband.py AsyncHyperBandScheduler)."""
+
+    def __init__(
+        self,
+        metric: str = "loss",
+        mode: str = "min",
+        time_attr: str = "training_iteration",
+        max_t: int = 100,
+        grace_period: int = 1,
+        reduction_factor: int = 4,
+    ):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.grace = grace_period
+        self.rf = reduction_factor
+        # rung milestones: grace, grace*rf, grace*rf^2, ... < max_t
+        self.rungs: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(t)
+            t *= reduction_factor
+        # recorded metric per rung
+        self._rung_scores: Dict[int, List[float]] = defaultdict(list)
+
+    def _better(self, a: float, b: float) -> bool:
+        return a < b if self.mode == "min" else a > b
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        t = result.get(self.time_attr)
+        score = result.get(self.metric)
+        if t is None or score is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP  # budget exhausted (trial done)
+        for rung in self.rungs:
+            if t == rung:
+                scores = self._rung_scores[rung]
+                scores.append(float(score))
+                if len(scores) < self.rf:
+                    return CONTINUE  # async: early trials pass through
+                k = max(1, len(scores) // self.rf)
+                top = sorted(scores, reverse=(self.mode == "max"))[:k]
+                keep = top[-1]
+                if not self._better(float(score), keep) and float(score) != keep:
+                    return STOP
+        return CONTINUE
+
+
+class MedianStoppingRule:
+    """Stop trials below the median of completed averages
+    (reference: tune/schedulers/median_stopping_rule.py)."""
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 time_attr: str = "training_iteration", grace_period: int = 1):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.grace = grace_period
+        self._history: Dict[str, List[float]] = defaultdict(list)
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        t = result.get(self.time_attr, 0)
+        score = result.get(self.metric)
+        if score is None:
+            return CONTINUE
+        self._history[trial_id].append(float(score))
+        if t < self.grace or len(self._history) < 3:
+            return CONTINUE
+        means = [sum(v) / len(v) for k, v in self._history.items() if k != trial_id]
+        if not means:
+            return CONTINUE
+        med = sorted(means)[len(means) // 2]
+        mine = sum(self._history[trial_id]) / len(self._history[trial_id])
+        if self.mode == "min" and mine > med:
+            return STOP
+        if self.mode == "max" and mine < med:
+            return STOP
+        return CONTINUE

@@ -1,0 +1,249 @@
+"""Tuner + trial controller.
+
+Reference call path: `Tuner.fit` (tune/tuner.py:43) → `TuneController`
+(tune/execution/tune_controller.py:72) — trials run as actors, the
+controller polls intermediate results, the scheduler may stop trials
+early, results land in a ResultGrid.
+
+TPU twist: a trial's resource request can be whole TPU hosts/slices;
+trials are actors so the raylet's TPU chip accounting applies unchanged.
+A trial may itself be a JaxTrainer run (Train-in-Tune, reference:
+train v2 runs as a Tune trial).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train.config import RunConfig
+from ray_tpu.tune.schedulers import CONTINUE, FIFOScheduler, STOP
+from ray_tpu.tune.search import generate_variants
+
+
+@dataclass
+class TuneConfig:
+    """Reference: tune/tune_config.py."""
+
+    metric: Optional[str] = None
+    mode: str = "min"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    scheduler: Any = None
+    seed: Optional[int] = None
+
+
+@dataclass
+class TrialResult:
+    trial_id: str
+    config: Dict[str, Any]
+    metrics: Dict[str, Any] = field(default_factory=dict)
+    history: List[Dict[str, Any]] = field(default_factory=list)
+    error: Optional[str] = None
+
+    @property
+    def done(self) -> bool:
+        return True
+
+
+class ResultGrid:
+    """Reference: tune/result_grid.py."""
+
+    def __init__(self, results: List[TrialResult], metric: Optional[str], mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self) -> int:
+        return len(self._results)
+
+    def __getitem__(self, i: int) -> TrialResult:
+        return self._results[i]
+
+    @property
+    def errors(self) -> List[str]:
+        return [r.error for r in self._results if r.error]
+
+    def get_best_result(self, metric: Optional[str] = None, mode: Optional[str] = None) -> TrialResult:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        scored = [r for r in self._results if r.error is None and metric in r.metrics]
+        if not scored:
+            raise ValueError("No successful trial reported metric " + str(metric))
+        return (min if mode == "min" else max)(scored, key=lambda r: r.metrics[metric])
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        rows = []
+        for r in self._results:
+            row = {"trial_id": r.trial_id, **{f"config/{k}": v for k, v in r.config.items()}}
+            row.update(r.metrics)
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+
+@ray_tpu.remote
+class _TrialActor:
+    """Runs one trial's function in a thread; controller polls reports.
+    max_concurrency=4 (set at creation) lets poll() run during the trial."""
+
+    def __init__(self):
+        self._reports: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+        self._done = False
+        self._error: Optional[str] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self, fn_bytes: bytes, config: Dict[str, Any]) -> bool:
+        from ray_tpu._private.serialization import loads_function
+        from ray_tpu.train import session as train_session
+
+        fn = loads_function(fn_bytes)
+        ctx = train_session.TrainContext(world_rank=0, world_size=1)
+        ctx._stop_event = self._stop
+        self._ctx = ctx
+
+        def _run():
+            train_session._set_session(ctx)
+            try:
+                fn(config)
+            except SystemExit:
+                pass
+            except BaseException:
+                with self._lock:
+                    self._error = traceback.format_exc()
+            finally:
+                train_session._set_session(None)
+                with self._lock:
+                    self._done = True
+
+        self._thread = threading.Thread(target=_run, daemon=True)
+        self._thread.start()
+        return True
+
+    def poll(self) -> Dict[str, Any]:
+        # drain the live session queue so intermediate reports reach the
+        # scheduler while the trial is still running (ASHA early stop)
+        ctx = getattr(self, "_ctx", None)
+        if ctx is not None:
+            while not ctx._report_queue.empty():
+                item = ctx._report_queue.get()
+                with self._lock:
+                    self._reports.append(item["metrics"])
+        with self._lock:
+            out = {"reports": list(self._reports), "done": self._done, "error": self._error}
+            self._reports.clear()
+        return out
+
+    def stop(self) -> bool:
+        self._stop.set()
+        return True
+
+
+class Tuner:
+    """Reference surface: tune/tuner.py:43."""
+
+    def __init__(
+        self,
+        trainable: Callable,
+        *,
+        param_space: Optional[Dict[str, Any]] = None,
+        tune_config: Optional[TuneConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        resources_per_trial: Optional[Dict[str, float]] = None,
+    ):
+        self._trainable = trainable
+        self._space = param_space or {}
+        self._cfg = tune_config or TuneConfig()
+        self._run = run_config or RunConfig()
+        self._resources = resources_per_trial or {}
+
+    def fit(self) -> ResultGrid:
+        from ray_tpu._private.serialization import dumps_function
+
+        variants = generate_variants(self._space, self._cfg.num_samples, self._cfg.seed)
+        scheduler = self._cfg.scheduler or FIFOScheduler()
+        max_conc = self._cfg.max_concurrent_trials
+        if max_conc is None:
+            # fit concurrency to the cluster so trial actors can schedule
+            # (reference: TuneController shares resources across trials)
+            try:
+                cpus = ray_tpu.cluster_resources().get("CPU", 8)
+            except Exception:
+                cpus = 8
+            per_trial = max(self._resources.get("CPU", 1), 0.5)
+            max_conc = max(1, min(len(variants), int(cpus / per_trial) - 1 or 1))
+        fn_b = dumps_function(self._trainable)
+
+        pending = [
+            TrialResult(trial_id=f"trial_{i:05d}", config=cfg)
+            for i, cfg in enumerate(variants)
+        ]
+        queue = list(pending)
+        running: Dict[str, Any] = {}  # trial_id -> (actor, TrialResult)
+        finished: List[TrialResult] = []
+
+        while queue or running:
+            # launch up to max_conc; scheduling pressure backs off instead
+            # of failing the trial
+            while queue and len(running) < max_conc:
+                tr = queue.pop(0)
+                actor = _TrialActor.options(
+                    max_concurrency=4,
+                    num_cpus=self._resources.get("CPU", 1),
+                    num_tpus=self._resources.get("TPU", 0),
+                ).remote()
+                try:
+                    ray_tpu.get(actor.start.remote(fn_b, tr.config))
+                except Exception:
+                    # couldn't place the actor (cluster full) — retry later
+                    try:
+                        ray_tpu.kill(actor)
+                    except Exception:
+                        pass
+                    queue.insert(0, tr)
+                    max_conc = max(1, len(running))
+                    break
+                running[tr.trial_id] = (actor, tr)
+            # poll
+            time.sleep(0.05)
+            for tid in list(running):
+                actor, tr = running[tid]
+                try:
+                    state = ray_tpu.get(actor.poll.remote())
+                except Exception as e:  # actor died
+                    tr.error = f"trial actor died: {e}"
+                    finished.append(tr)
+                    running.pop(tid)
+                    continue
+                for rep in state["reports"]:
+                    tr.history.append(rep)
+                    tr.metrics = rep
+                    if scheduler.on_result(tid, rep) == STOP and not state["done"]:
+                        try:
+                            actor.stop.remote()
+                        except Exception:
+                            pass
+                if state["done"]:
+                    tr.error = state["error"]
+                    finished.append(tr)
+                    running.pop(tid)
+                    try:
+                        ray_tpu.kill(actor)
+                    except Exception:
+                        pass
+        return ResultGrid(finished, self._cfg.metric, self._cfg.mode)
+
+
+def report(metrics: Dict[str, Any], **kwargs) -> None:
+    """tune.report — same session channel as train.report
+    (reference: tune reuses the train session, train/_internal/session.py)."""
+    from ray_tpu.train.session import report as _report
+
+    _report(metrics, **kwargs)

@@ -1,0 +1,30 @@
+"""ray_tpu.util — placement groups, scheduling strategies, TPU slices,
+collectives (reference: python/ray/util)."""
+
+from ray_tpu.util.placement_group import (
+    PACK,
+    SPREAD,
+    STRICT_PACK,
+    STRICT_SPREAD,
+    PlacementGroup,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+
+__all__ = [
+    "PACK",
+    "SPREAD",
+    "STRICT_PACK",
+    "STRICT_SPREAD",
+    "NodeAffinitySchedulingStrategy",
+    "PlacementGroup",
+    "PlacementGroupSchedulingStrategy",
+    "placement_group",
+    "placement_group_table",
+    "remove_placement_group",
+]

@@ -1,0 +1,58 @@
+"""State API — observability over cluster entities.
+
+Reference: python/ray/util/state/ (`StateApiClient` api.py:114,
+`list_actors` :793, `list_tasks` :1020), backed by the GCS. Same shape
+here: list/get functions returning plain dicts from the control plane.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import worker as worker_mod
+
+
+def _gcs():
+    return worker_mod._require_connected().core.gcs
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    """Reference: util/state list_nodes."""
+    return worker_mod._require_connected().core.nodes()
+
+
+def list_actors(filters: Optional[List] = None) -> List[Dict[str, Any]]:
+    """Reference: util/state/api.py:793."""
+    actors = _gcs().call_retrying("ListActors")
+    out = [a for a in actors if a is not None]
+    for f in filters or []:
+        key, op, val = f
+        if op == "=":
+            out = [a for a in out if a.get(key) == val]
+        elif op == "!=":
+            out = [a for a in out if a.get(key) != val]
+    return out
+
+
+def get_actor(actor_id: str) -> Optional[Dict[str, Any]]:
+    return _gcs().call_retrying("GetActorInfo", actor_id=actor_id)
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    return _gcs().call_retrying("ListPlacementGroups")
+
+
+def list_jobs() -> List[Dict[str, Any]]:
+    return _gcs().call_retrying("ListJobs")
+
+
+def cluster_summary() -> Dict[str, Any]:
+    """Aggregate view (reference: `ray status` output / state summary)."""
+    core = worker_mod._require_connected().core
+    return {
+        "nodes": core.nodes(),
+        "total_resources": core.cluster_resources(),
+        "available_resources": core.available_resources(),
+        "actors": len(list_actors()),
+        "placement_groups": len(list_placement_groups()),
+    }

@@ -1,0 +1,123 @@
+"""TPU slice orchestration (reference: python/ray/util/tpu.py — 843 LoC;
+SlicePlacementGroup :420, get_tpu_coordinator_env_vars :212).
+
+A pod slice is a gang: all hosts of the slice or none. The slice-head
+resource (`TPU-{pod_type}-head`, one per slice, held by host 0) makes
+the reservation atomic — the head bundle can only be granted once, and
+the per-host bundles land on the slice's hosts via the PG 2PC.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from ray_tpu.accelerators.tpu import (
+    num_hosts_in_slice,
+    parse_pod_type,
+    slice_head_resource_name,
+    _CHIPS_PER_HOST,
+)
+from ray_tpu.parallel.bootstrap import HostGroupSpec, megascale_env
+from ray_tpu.util.placement_group import (
+    PlacementGroup,
+    STRICT_SPREAD,
+    placement_group,
+    remove_placement_group,
+)
+
+
+@dataclasses.dataclass
+class SliceInfo:
+    pod_type: str  # e.g. "v5litepod-16"
+    num_hosts: int
+    chips_per_host: int
+    num_slices: int = 1
+
+
+class SlicePlacementGroup:
+    """Reserve a whole TPU slice (reference: util/tpu.py:420).
+
+    Bundle 0 carries the slice-head resource + host-0 chips; bundles
+    1..H-1 carry the other hosts' chips. Workers target bundles via
+    PlacementGroupSchedulingStrategy(bundle_index=host_rank).
+    """
+
+    def __init__(self, topology: str, *, num_slices: int = 1, name: str = ""):
+        gen, chips = parse_pod_type(topology)
+        per_host = _CHIPS_PER_HOST.get(gen, 4)
+        hosts = num_hosts_in_slice(topology)
+        self.info = SliceInfo(
+            pod_type=topology,
+            num_hosts=hosts,
+            chips_per_host=min(per_host, chips),
+            num_slices=num_slices,
+        )
+        self._pgs: List[PlacementGroup] = []
+        for s in range(num_slices):
+            bundles: List[Dict[str, float]] = []
+            for h in range(hosts):
+                # one CPU per host rides along for the worker actor itself
+                b: Dict[str, float] = {
+                    "CPU": 1.0,
+                    "TPU": float(self.info.chips_per_host),
+                }
+                if h == 0:
+                    b[slice_head_resource_name(topology)] = 1.0
+                bundles.append(b)
+            self._pgs.append(
+                placement_group(
+                    bundles,
+                    strategy=STRICT_SPREAD if hosts > 1 else "PACK",
+                    name=f"{name or 'slice'}-{s}",
+                )
+            )
+
+    @property
+    def placement_groups(self) -> List[PlacementGroup]:
+        return self._pgs
+
+    @property
+    def placement_group(self) -> PlacementGroup:
+        return self._pgs[0]
+
+    @property
+    def num_workers(self) -> int:
+        return self.info.num_hosts * self.info.num_slices
+
+    def ready(self, timeout: Optional[float] = None) -> bool:
+        return all(pg.ready(timeout=timeout) for pg in self._pgs)
+
+    def remove(self) -> None:
+        for pg in self._pgs:
+            try:
+                remove_placement_group(pg)
+            except Exception:
+                pass
+
+    def host_group_specs(self, coordinator_address: str) -> List[HostGroupSpec]:
+        """jax.distributed + MEGASCALE bootstrap specs for every host
+        process in the gang (reference: get_tpu_coordinator_env_vars
+        util/tpu.py:212 + train/v2/jax/config.py:60)."""
+        total = self.num_workers
+        specs = []
+        for s in range(self.info.num_slices):
+            for h in range(self.info.num_hosts):
+                specs.append(
+                    HostGroupSpec(
+                        coordinator_address=coordinator_address,
+                        num_processes=total,
+                        process_id=s * self.info.num_hosts + h,
+                        num_slices=self.info.num_slices,
+                        slice_id=s,
+                        megascale_coordinator=coordinator_address.split(":")[0]
+                        if self.info.num_slices > 1
+                        else None,
+                    )
+                )
+        return specs
+
+
+def get_tpu_coordinator_env_vars(spec: HostGroupSpec) -> Dict[str, str]:
+    """MEGASCALE_* env for a host (reference: util/tpu.py:212)."""
+    return megascale_env(spec)

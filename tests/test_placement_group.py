@@ -1,0 +1,123 @@
+"""Placement-group tests (reference: python/ray/tests/test_placement_group*.py;
+TPU slice gang reservation per util/tpu.py:420)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import (
+    PlacementGroupSchedulingStrategy,
+    placement_group,
+    remove_placement_group,
+)
+from ray_tpu.util.tpu import SlicePlacementGroup
+
+
+@pytest.fixture
+def tpu_cluster():
+    import os
+
+    os.environ["TPU_ACCELERATOR_TYPE"] = "v5litepod-4"
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+    os.environ.pop("TPU_ACCELERATOR_TYPE", None)
+
+
+class TestPlacementGroup:
+    def test_create_ready_remove(self, ray_start_regular):
+        pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+        assert pg.ready(timeout=30)
+        remove_placement_group(pg)
+
+    def test_infeasible_not_ready(self, ray_start_regular):
+        pg = placement_group([{"CPU": 64}], strategy="PACK")
+        assert not pg.ready(timeout=2)
+        remove_placement_group(pg)
+
+    def test_actor_in_bundle(self, ray_start_regular):
+        pg = placement_group([{"CPU": 1}], strategy="PACK")
+        assert pg.ready(timeout=30)
+
+        @ray_tpu.remote
+        class A:
+            def ping(self):
+                return "pong"
+
+        a = A.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group=pg, placement_group_bundle_index=0
+            )
+        ).remote()
+        assert ray_tpu.get(a.ping.remote()) == "pong"
+        ray_tpu.kill(a)
+        remove_placement_group(pg)
+
+    def test_bundle_resources_capacity(self, ray_start_regular):
+        """Tasks in a 1-CPU bundle can't exceed the bundle's capacity."""
+        pg = placement_group([{"CPU": 1}], strategy="PACK")
+        assert pg.ready(timeout=30)
+
+        @ray_tpu.remote
+        def f():
+            import time
+
+            time.sleep(0.5)
+            return 1
+
+        strat = PlacementGroupSchedulingStrategy(placement_group=pg)
+        import time
+
+        t0 = time.monotonic()
+        refs = [f.options(scheduling_strategy=strat).remote() for _ in range(3)]
+        assert ray_tpu.get(refs) == [1, 1, 1]
+        # 3 tasks on a 1-CPU bundle must serialize: >= ~1.5s
+        assert time.monotonic() - t0 >= 1.2
+        remove_placement_group(pg)
+
+    def test_validation(self, ray_start_regular):
+        with pytest.raises(ValueError):
+            placement_group([], strategy="PACK")
+        with pytest.raises(ValueError):
+            placement_group([{"CPU": 1}], strategy="DIAGONAL")
+
+
+class TestSlicePlacementGroup:
+    def test_single_host_slice(self, tpu_cluster):
+        spg = SlicePlacementGroup("v5litepod-4")
+        assert spg.info.num_hosts == 1
+        assert spg.num_workers == 1
+        assert spg.ready(timeout=30)
+
+        @ray_tpu.remote
+        class HostWorker:
+            def chips(self):
+                import os
+
+                return os.environ.get("TPU_VISIBLE_CHIPS")
+
+        w = HostWorker.options(
+            num_tpus=4,
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group=spg.placement_group, placement_group_bundle_index=0
+            ),
+        ).remote()
+        chips = ray_tpu.get(w.chips.remote())
+        assert chips is not None and len(chips.split(",")) == 4
+        ray_tpu.kill(w)
+        spg.remove()
+
+    def test_host_group_specs_multislice(self, tpu_cluster):
+        spg = SlicePlacementGroup.__new__(SlicePlacementGroup)
+        from ray_tpu.util.tpu import SliceInfo
+
+        spg.info = SliceInfo(pod_type="v5litepod-8", num_hosts=2, chips_per_host=4,
+                             num_slices=2)
+        spg._pgs = []
+        specs = spg.host_group_specs("10.0.0.1:8476")
+        assert len(specs) == 4
+        assert specs[3].process_id == 3 and specs[3].slice_id == 1
+        from ray_tpu.util.tpu import get_tpu_coordinator_env_vars
+
+        env = get_tpu_coordinator_env_vars(specs[2])
+        assert env["MEGASCALE_NUM_SLICES"] == "2"
+        assert env["MEGASCALE_SLICE_ID"] == "1"

@@ -1,0 +1,191 @@
+"""Serve tests (reference strategy: python/ray/serve/tests — 153 files;
+here: deploy/route/handle, replicas, batching, reconfigure, HTTP proxy)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_cluster(ray_start_regular):
+    yield
+    serve.shutdown()
+
+
+class TestDeployment:
+    def test_function_deployment(self, serve_cluster):
+        @serve.deployment
+        def doubler(x):
+            return x * 2
+
+        h = serve.run(doubler.bind())
+        assert h.remote(21).result() == 42
+
+    def test_class_deployment_with_state(self, serve_cluster):
+        @serve.deployment(num_replicas=1)
+        class Counter:
+            def __init__(self, start):
+                self.n = start
+
+            def incr(self, k):
+                self.n += k
+                return self.n
+
+        h = serve.run(Counter.bind(100))
+        assert h.incr.remote(5).result() == 105
+        assert h.incr.remote(5).result() == 110
+
+    def test_multiple_replicas_route(self, serve_cluster):
+        @serve.deployment(num_replicas=2)
+        class Who:
+            def __init__(self):
+                import os
+
+                self.pid = os.getpid()
+
+            def __call__(self, _):
+                return self.pid
+
+        h = serve.run(Who.bind())
+        pids = {h.remote(None).result() for _ in range(20)}
+        assert len(pids) == 2  # both replicas served traffic
+
+    def test_options_override(self, serve_cluster):
+        @serve.deployment
+        def f(x):
+            return x
+
+        d = f.options(name="custom", num_replicas=1)
+        h = serve.run(d.bind())
+        assert h.remote(7).result() == 7
+        assert "custom" in serve.status()["deployments"]
+
+    def test_get_app_handle_and_delete(self, serve_cluster):
+        @serve.deployment(name="app1")
+        def f(x):
+            return x + 1
+
+        serve.run(f.bind())
+        h = serve.get_app_handle("app1")
+        assert h.remote(1).result() == 2
+        serve.delete("app1")
+        with pytest.raises(ValueError):
+            serve.get_app_handle("app1")
+
+    def test_error_propagates(self, serve_cluster):
+        @serve.deployment
+        def bad(x):
+            raise ValueError("boom")
+
+        h = serve.run(bad.bind())
+        with pytest.raises(Exception, match="boom"):
+            h.remote(1).result()
+
+
+class TestBatching:
+    def test_batch_collects_concurrent_calls(self, serve_cluster):
+        @serve.deployment(max_ongoing_requests=16)
+        class Model:
+            @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.2)
+            def predict(self, xs):
+                # returns batch size with each result to observe batching
+                return [(x, len(xs)) for x in xs]
+
+        h = serve.run(Model.bind())
+        results = []
+        threads = [
+            threading.Thread(target=lambda i=i: results.append(h.predict.remote(i).result()))
+            for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sorted(r[0] for r in results) == list(range(8))
+        assert max(r[1] for r in results) > 1  # at least one real batch formed
+
+    def test_batch_free_function(self):
+        calls = []
+
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.1)
+        def predict(xs):
+            calls.append(len(xs))
+            return [x * 10 for x in xs]
+
+        outs = []
+        threads = [
+            threading.Thread(target=lambda i=i: outs.append(predict(i))) for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sorted(outs) == [0, 10, 20, 30]
+
+
+class TestHTTPProxy:
+    def test_http_roundtrip(self, serve_cluster):
+        @serve.deployment(name="adder")
+        def adder(payload):
+            return payload["a"] + payload["b"]
+
+        serve.run(adder.bind())
+        port = serve.start_http_proxy(port=0)
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/adder",
+                data=json.dumps({"a": 2, "b": 3}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                body = json.loads(resp.read())
+            assert body["result"] == 5
+        finally:
+            serve.stop_http_proxy()
+
+
+class TestModelServing:
+    def test_jax_model_replica(self, serve_cluster):
+        """A model-on-TPU-style replica: jitted forward under batching
+        (BASELINE.md 'Serve BERT-base replicas with dynamic batching'
+        shape of workload, tiny here)."""
+
+        @serve.deployment(max_ongoing_requests=8)
+        class TinyLM:
+            def __init__(self):
+                import jax
+
+                # pin to CPU inside the replica process (the axon
+                # sitecustomize would otherwise aim jax at the TPU tunnel)
+                jax.config.update("jax_platforms", "cpu")
+
+                import ray_tpu.models.transformer as T
+
+                self.cfg = T.config("debug")
+                self.params = T.init_params(self.cfg, jax.random.key(0))
+                import functools
+
+                self.fwd = jax.jit(
+                    functools.partial(T.forward, self.cfg)
+                )
+
+            @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.05)
+            def predict(self, token_lists):
+                import jax.numpy as jnp
+                import numpy as np
+
+                toks = jnp.asarray(np.stack(token_lists).astype(np.int32))
+                logits = self.fwd(self.params, toks)
+                return [np.asarray(l[-1]).argmax().item() for l in logits]
+
+        h = serve.run(TinyLM.bind())
+        tokens = np.ones(16, dtype=np.int32)
+        out = h.predict.remote(tokens).result(timeout=120)
+        assert isinstance(out, int)

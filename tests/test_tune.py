@@ -1,0 +1,123 @@
+"""Tune tests (reference strategy: python/ray/tune/tests — 55 files;
+here: variant generation, end-to-end Tuner over actors, ASHA stopping,
+best-result selection, Train-in-Tune)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune.search import generate_variants
+
+
+class TestSearchSpace:
+    def test_grid_cross_product(self):
+        space = {"a": tune.grid_search([1, 2, 3]), "b": tune.grid_search([10, 20])}
+        vs = generate_variants(space, num_samples=1)
+        assert len(vs) == 6
+        assert {(v["a"], v["b"]) for v in vs} == {(a, b) for a in (1, 2, 3) for b in (10, 20)}
+
+    def test_sampling_domains(self):
+        space = {
+            "lr": tune.loguniform(1e-5, 1e-1),
+            "wd": tune.uniform(0.0, 0.3),
+            "bs": tune.choice([16, 32]),
+            "layers": tune.randint(1, 5),
+        }
+        vs = generate_variants(space, num_samples=20, seed=0)
+        assert len(vs) == 20
+        assert all(1e-5 <= v["lr"] <= 1e-1 for v in vs)
+        assert all(v["bs"] in (16, 32) for v in vs)
+        assert all(1 <= v["layers"] < 5 for v in vs)
+
+    def test_num_samples_multiplies_grid(self):
+        space = {"a": tune.grid_search([1, 2]), "x": tune.uniform(0, 1)}
+        assert len(generate_variants(space, num_samples=3)) == 6
+
+
+class TestTuner:
+    def test_fit_selects_best(self, ray_start_regular):
+        def objective(config):
+            score = (config["x"] - 3) ** 2
+            tune.report({"score": score, "training_iteration": 1})
+
+        grid = tune.Tuner(
+            objective,
+            param_space={"x": tune.grid_search([0, 1, 2, 3, 4])},
+            tune_config=tune.TuneConfig(metric="score", mode="min"),
+        ).fit()
+        assert len(grid) == 5
+        best = grid.get_best_result()
+        assert best.config["x"] == 3
+        assert best.metrics["score"] == 0
+
+    def test_trial_error_captured(self, ray_start_regular):
+        def objective(config):
+            if config["x"] == 1:
+                raise RuntimeError("bad trial")
+            tune.report({"score": config["x"], "training_iteration": 1})
+
+        grid = tune.Tuner(
+            objective,
+            param_space={"x": tune.grid_search([0, 1, 2])},
+            tune_config=tune.TuneConfig(metric="score", mode="max"),
+        ).fit()
+        assert len(grid.errors) == 1
+        assert grid.get_best_result().config["x"] == 2
+
+    def test_asha_stops_bad_trials(self, ray_start_regular):
+        def objective(config):
+            import time
+
+            for i in range(1, 20):
+                # bad configs plateau high; good configs descend. Good
+                # trials iterate faster, so they populate ASHA's rungs
+                # first (async halving stops laggards against the rung
+                # cutoff — lockstep arrival would never trigger it).
+                loss = config["base"] - i * config["slope"]
+                tune.report({"loss": loss, "training_iteration": i})
+                time.sleep(0.04 if config["base"] < 1 else 0.15)
+
+        sched = tune.ASHAScheduler(
+            metric="loss", mode="min", max_t=20, grace_period=2, reduction_factor=2
+        )
+        grid = tune.Tuner(
+            objective,
+            param_space={
+                "base": tune.grid_search([0.5, 0.5, 10.0, 10.0]),
+                "slope": 0.02,
+            },
+            tune_config=tune.TuneConfig(metric="loss", mode="min", scheduler=sched,
+                                        max_concurrent_trials=4),
+        ).fit()
+        best = grid.get_best_result()
+        assert best.config["base"] == 0.5
+        # at least one bad trial was cut before finishing all 19 iters
+        bad = [r for r in grid if r.config["base"] == 10.0]
+        assert any(len(r.history) < 19 for r in bad)
+
+    def test_train_in_tune(self, ray_start_regular, tmp_path):
+        """A trial that itself runs a JaxTrainer fit (reference: Train v2
+        runs as a Tune trial)."""
+
+        def trial(config):
+            import ray_tpu.train as train
+
+            def loop(cfg):
+                train.report({"loss": 1.0 / (1 + cfg["lr"])})
+
+            res = train.JaxTrainer(
+                loop,
+                train_loop_config={"lr": config["lr"]},
+                run_config=train.RunConfig(
+                    name=f"inner_{config['lr']}", storage_path=str(tmp_path)
+                ),
+            ).fit()
+            tune.report({"loss": res.metrics["loss"], "training_iteration": 1})
+
+        grid = tune.Tuner(
+            trial,
+            param_space={"lr": tune.grid_search([0.1, 1.0])},
+            tune_config=tune.TuneConfig(metric="loss", mode="min"),
+        ).fit()
+        assert grid.get_best_result().config["lr"] == 1.0
