@@ -58,6 +58,13 @@ class TransformerConfig:
     remat: bool = True  # jax.checkpoint each block
     lora_rank: int = 0  # 0 = dense training; >0 = LoRA adapters on attn+mlp
     lora_alpha: float = 16.0
+    # Mixture-of-experts (0 = dense MLP). Experts shard over the "expert"
+    # mesh axis (EP); routing is top-k token-choice with capacity drop —
+    # the Mixtral/Switch recipe expressed as dense einsums so GSPMD can
+    # partition on the expert dim (no gather/scatter on the hot path).
+    num_experts: int = 0
+    experts_per_token: int = 2
+    capacity_factor: float = 1.25
 
     @property
     def hd(self) -> int:
@@ -72,7 +79,10 @@ class TransformerConfig:
     def num_params(self) -> int:
         h, m, l, v = self.hidden, self.mlp_hidden, self.layers, self.vocab_size
         hd, nh, nkv = self.hd, self.heads, self.kv_heads
-        per_layer = h * (nh * hd) + 2 * h * (nkv * hd) + (nh * hd) * h + 3 * h * m + 2 * h
+        mlp = 3 * h * m
+        if self.num_experts:
+            mlp = self.num_experts * 3 * h * m + h * self.num_experts  # + router
+        per_layer = h * (nh * hd) + 2 * h * (nkv * hd) + (nh * hd) * h + mlp + 2 * h
         emb = v * h * (1 if self.tie_embeddings else 2)
         return l * per_layer + emb + h
 
@@ -93,6 +103,17 @@ PRESETS: Dict[str, TransformerConfig] = {
     "llama3_8b": TransformerConfig(
         vocab_size=128256, hidden=4096, mlp_hidden=14336, layers=32,
         heads=32, kv_heads=8, max_seq=8192, rope_theta=500000.0,
+    ),
+    # Mixtral-8x7B-shaped MoE (EP flagship)
+    "mixtral_8x7b": TransformerConfig(
+        vocab_size=32000, hidden=4096, mlp_hidden=14336, layers=32,
+        heads=32, kv_heads=8, max_seq=8192, rope_theta=1e6,
+        num_experts=8, experts_per_token=2,
+    ),
+    "moe_debug": TransformerConfig(
+        vocab_size=512, hidden=128, mlp_hidden=256, layers=2, heads=4,
+        kv_heads=2, max_seq=128, remat=False, num_experts=4,
+        experts_per_token=2,
     ),
 }
 
@@ -124,19 +145,27 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
         ks = jax.random.split(k, l)
         return jnp.stack([_dense_init(ks[i], shape, pd, fan_in) for i in range(l)])
 
+    blocks: Params = {
+        "wq": stack(keys[1], (h, nh, hd), h),
+        "wk": stack(keys[2], (h, nkv, hd), h),
+        "wv": stack(keys[3], (h, nkv, hd), h),
+        "wo": stack(keys[4], (nh, hd, h), nh * hd),
+        "ln_attn": jnp.ones((l, h), pd),
+        "ln_mlp": jnp.ones((l, h), pd),
+    }
+    if cfg.num_experts:
+        e = cfg.num_experts
+        blocks["router"] = stack(keys[5], (h, e), h)
+        blocks["wi_gate"] = stack(keys[6], (e, h, m), h)
+        blocks["wi_up"] = stack(keys[7], (e, h, m), h)
+        blocks["wo_mlp"] = stack(keys[8], (e, m, h), m)
+    else:
+        blocks["wi_gate"] = stack(keys[5], (h, m), h)
+        blocks["wi_up"] = stack(keys[6], (h, m), h)
+        blocks["wo_mlp"] = stack(keys[7], (m, h), m)
     params: Params = {
         "embed": _dense_init(keys[0], (v, h), pd, h),  # scaled like output
-        "blocks": {
-            "wq": stack(keys[1], (h, nh, hd), h),
-            "wk": stack(keys[2], (h, nkv, hd), h),
-            "wv": stack(keys[3], (h, nkv, hd), h),
-            "wo": stack(keys[4], (nh, hd, h), nh * hd),
-            "wi_gate": stack(keys[5], (h, m), h),
-            "wi_up": stack(keys[6], (h, m), h),
-            "wo_mlp": stack(keys[7], (m, h), m),
-            "ln_attn": jnp.ones((l, h), pd),
-            "ln_mlp": jnp.ones((l, h), pd),
-        },
+        "blocks": blocks,
         "ln_f": jnp.ones((h,), pd),
     }
     if not cfg.tie_embeddings:
@@ -156,19 +185,30 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
 def param_axes(cfg: TransformerConfig) -> Params:
     """Pytree of logical-axis tuples mirroring init_params output.
     Feed to parallel.sharding.tree_shardings(mesh, ...) for NamedShardings."""
-    axes: Params = {
-        "embed": ("vocab", "embed"),
-        "blocks": {
-            "wq": ("layers", "embed", "heads", "head_dim"),
-            "wk": ("layers", "embed", "kv_heads", "head_dim"),
-            "wv": ("layers", "embed", "kv_heads", "head_dim"),
-            "wo": ("layers", "heads", "head_dim", "embed"),
+    block_axes: Params = {
+        "wq": ("layers", "embed", "heads", "head_dim"),
+        "wk": ("layers", "embed", "kv_heads", "head_dim"),
+        "wv": ("layers", "embed", "kv_heads", "head_dim"),
+        "wo": ("layers", "heads", "head_dim", "embed"),
+        "ln_attn": ("layers", "norm"),
+        "ln_mlp": ("layers", "norm"),
+    }
+    if cfg.num_experts:
+        block_axes.update({
+            "router": ("layers", "embed", None),  # router stays replicated
+            "wi_gate": ("layers", "expert", "embed", "mlp"),
+            "wi_up": ("layers", "expert", "embed", "mlp"),
+            "wo_mlp": ("layers", "expert", "mlp", "embed"),
+        })
+    else:
+        block_axes.update({
             "wi_gate": ("layers", "embed", "mlp"),
             "wi_up": ("layers", "embed", "mlp"),
             "wo_mlp": ("layers", "mlp", "embed"),
-            "ln_attn": ("layers", "norm"),
-            "ln_mlp": ("layers", "norm"),
-        },
+        })
+    axes: Params = {
+        "embed": ("vocab", "embed"),
+        "blocks": block_axes,
         "ln_f": ("norm",),
     }
     if not cfg.tie_embeddings:
@@ -209,6 +249,50 @@ def _lora_delta(x, a, b, scale):
     return jnp.einsum("bsh,hr->bsr", x, a.astype(x.dtype)) @ b.astype(x.dtype) * scale
 
 
+def _moe_mlp(cfg: TransformerConfig, y, p):
+    """Top-k token-choice MoE with capacity drop (GShard/Mixtral recipe).
+
+    Dense-dispatch formulation: routing becomes one-hot dispatch/combine
+    tensors and the expert FFN is a single batched einsum with the expert
+    dim sharded over the "expert" mesh axis — GSPMD inserts the
+    all-to-alls; no dynamic gather on the TPU hot path.
+    """
+    b, s, h = y.shape
+    e, k = cfg.num_experts, cfg.experts_per_token
+    t = b * s
+    x = y.reshape(t, h)
+
+    logits = jnp.einsum("th,he->te", x, p["router"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, gate_idx = lax.top_k(probs, k)  # [T,k]
+    gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
+
+    # capacity per expert; first-choice assignments get priority by
+    # ordering the flattened (choice-major) token stream
+    cap = max(4, int(cfg.capacity_factor * t * k / e))
+    oh = jax.nn.one_hot(gate_idx, e, dtype=jnp.float32)          # [T,k,E]
+    ohf = oh.transpose(1, 0, 2).reshape(k * t, e)                # choice-major
+    pos = (jnp.cumsum(ohf, axis=0) - 1.0) * ohf                  # slot per entry
+    keep = (pos < cap) & (ohf > 0)
+    slot = pos.sum(-1).astype(jnp.int32)                         # [kT]
+    slot_oh = jax.nn.one_hot(slot, cap, dtype=jnp.float32)       # [kT,C]
+    dispatch = ohf[:, :, None] * slot_oh[:, None, :] * keep.any(-1)[:, None, None]
+    gates_f = gate_vals.T.reshape(k * t)                         # choice-major
+    combine = dispatch * gates_f[:, None, None]
+
+    xk = jnp.tile(x, (k, 1)).astype(jnp.float32)                 # [kT,h]
+    expert_in = jnp.einsum("pec,ph->ech", dispatch, xk).astype(y.dtype)
+    expert_in = constrain(expert_in, ("expert", None, "embed"))
+    gate = jnp.einsum("ech,ehm->ecm", expert_in, p["wi_gate"].astype(y.dtype))
+    up = jnp.einsum("ech,ehm->ecm", expert_in, p["wi_up"].astype(y.dtype))
+    act = jax.nn.silu(gate) * up
+    act = constrain(act, ("expert", None, "mlp"))
+    out_e = jnp.einsum("ecm,emh->ech", act, p["wo_mlp"].astype(y.dtype))
+    yk = jnp.einsum("pec,ech->ph", combine.astype(y.dtype), out_e)  # [kT,h]
+    out = yk.reshape(k, t, h).sum(0).reshape(b, s, h)
+    return out
+
+
 def _block(cfg: TransformerConfig, x, layer_params, lora_params, positions,
            attn_fn):
     """One decoder block. x [B,S,H_emb] in compute dtype."""
@@ -232,13 +316,16 @@ def _block(cfg: TransformerConfig, x, layer_params, lora_params, positions,
     x = x + constrain(attn, ("batch", "seq", "embed"))
 
     y = _rms_norm(x, p["ln_mlp"], cfg.norm_eps)
-    gate = jnp.einsum("bsh,hm->bsm", y, p["wi_gate"].astype(y.dtype))
-    up = jnp.einsum("bsh,hm->bsm", y, p["wi_up"].astype(y.dtype))
-    if lora_params is not None:
-        gate = gate + _lora_delta(y, lora_params["wi_a"], lora_params["wi_b"], scale)
-    act = jax.nn.silu(gate) * up
-    act = constrain(act, ("batch", "seq", "mlp"))
-    out = jnp.einsum("bsm,mh->bsh", act, p["wo_mlp"].astype(act.dtype))
+    if cfg.num_experts:
+        out = _moe_mlp(cfg, y, p)
+    else:
+        gate = jnp.einsum("bsh,hm->bsm", y, p["wi_gate"].astype(y.dtype))
+        up = jnp.einsum("bsh,hm->bsm", y, p["wi_up"].astype(y.dtype))
+        if lora_params is not None:
+            gate = gate + _lora_delta(y, lora_params["wi_a"], lora_params["wi_b"], scale)
+        act = jax.nn.silu(gate) * up
+        act = constrain(act, ("batch", "seq", "mlp"))
+        out = jnp.einsum("bsm,mh->bsh", act, p["wo_mlp"].astype(act.dtype))
     return x + constrain(out, ("batch", "seq", "embed"))
 
 
@@ -251,11 +338,14 @@ def _default_attn(cfg: TransformerConfig):
 
 def forward(cfg: TransformerConfig, params: Params, tokens: jax.Array,
             positions: Optional[jax.Array] = None,
-            attn_fn=None) -> jax.Array:
+            attn_fn=None, mesh=None,
+            num_microbatches: Optional[int] = None) -> jax.Array:
     """tokens [B,S] int32 → logits [B,S,V] (compute dtype).
 
     ``attn_fn(q,k,v)->o`` overrides attention — ring_attention for
     sequence parallelism is passed in by the train-step builder.
+    ``mesh`` with a "stage" axis > 1 switches the layer stack to
+    pipeline parallelism (ops/pipeline.py) with ``num_microbatches``.
     """
     if positions is None:
         positions = jnp.arange(tokens.shape[1])
@@ -274,8 +364,22 @@ def forward(cfg: TransformerConfig, params: Params, tokens: jax.Array,
     layer_tree = {"p": blocks}
     if lora is not None:
         layer_tree["l"] = lora
-    body_fn = jax.checkpoint(body) if cfg.remat else body
-    x, _ = lax.scan(body_fn, x, layer_tree)
+    n_stage = mesh.shape.get("stage", 1) if mesh is not None else 1
+    if n_stage > 1:
+        from ray_tpu.ops.pipeline import pipelined_layers
+
+        def apply_stage(layers_local, h):
+            body_fn = jax.checkpoint(body) if cfg.remat else body
+            h, _ = lax.scan(body_fn, h, layers_local)
+            return h
+
+        x = pipelined_layers(
+            mesh, apply_stage, layer_tree, x,
+            num_microbatches or 2 * n_stage,
+        )
+    else:
+        body_fn = jax.checkpoint(body) if cfg.remat else body
+        x, _ = lax.scan(body_fn, x, layer_tree)
 
     x = _rms_norm(x, params["ln_f"], cfg.norm_eps)
     unembed = params.get("unembed")
@@ -286,13 +390,15 @@ def forward(cfg: TransformerConfig, params: Params, tokens: jax.Array,
 
 
 def loss_fn(cfg: TransformerConfig, params: Params, batch: Dict[str, jax.Array],
-            attn_fn=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+            attn_fn=None, mesh=None,
+            num_microbatches: Optional[int] = None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Next-token cross-entropy. batch: tokens [B,S], optional loss_mask [B,S].
     Returns (loss, metrics)."""
     tokens = batch["tokens"]
     # Forward over the FULL sequence (sequence-parallel shards must keep
     # S divisible by the mesh axis); shift at the logits instead.
-    logits = forward(cfg, params, tokens, attn_fn=attn_fn)[:, :-1]
+    logits = forward(cfg, params, tokens, attn_fn=attn_fn, mesh=mesh,
+                     num_microbatches=num_microbatches)[:, :-1]
     targets = tokens[:, 1:]
     logits = logits.astype(jnp.float32)
     logz = jax.nn.logsumexp(logits, axis=-1)

@@ -86,11 +86,22 @@ def make_attn_fn(cfg: TransformerConfig, mesh: Mesh,
     return attn
 
 
+def _effective_rules(mesh: Mesh, rules: Optional[Rules]) -> Rules:
+    """Base rules + PP: with a real stage axis, layer-stacked params shard
+    their leading (layers) dim over "stage" so each stage holds only its
+    own layers."""
+    rules = dict(rules or DEFAULT_RULES)
+    if mesh_axis_size(mesh, "stage") > 1:
+        rules.setdefault("layers", "stage")
+    return rules
+
+
 def state_shardings(cfg: TransformerConfig, optimizer: optax.GradientTransformation,
                     mesh: Mesh, rules: Optional[Rules] = None) -> TrainState:
     """NamedShardings for the full train state. Optimizer-state leaves
     that mirror params (adam mu/nu) inherit the param shardings via
     optax.tree_map_params; scalars replicate."""
+    rules = _effective_rules(mesh, rules)
     axes = param_axes(cfg)
     p_shard = tree_shardings(mesh, axes, rules)
     repl = NamedSharding(mesh, P())
@@ -138,10 +149,18 @@ def init_state(cfg: TransformerConfig, optimizer: optax.GradientTransformation,
 
 def make_train_step(cfg: TransformerConfig, optimizer: optax.GradientTransformation,
                     mesh: Mesh, rules: Optional[Rules] = None,
-                    donate: bool = True) -> Callable[[TrainState, Dict], Tuple[TrainState, Dict]]:
+                    donate: bool = True,
+                    num_microbatches: Optional[int] = None) -> Callable[[TrainState, Dict], Tuple[TrainState, Dict]]:
     """Build the jitted sharded train step: (state, batch) → (state, metrics)."""
-    rules = rules or DEFAULT_RULES
+    rules = _effective_rules(mesh, rules)
     attn = make_attn_fn(cfg, mesh, rules)
+    n_stage = mesh_axis_size(mesh, "stage")
+    if n_stage > 1 and mesh_axis_size(mesh, "sequence") > 1:
+        raise NotImplementedError(
+            "stage (pipeline) and sequence (ring attention) parallelism "
+            "cannot be combined yet — nested shard_map regions"
+        )
+    pp_mesh = mesh if n_stage > 1 else None
     shardings = state_shardings(cfg, optimizer, mesh, rules)
     b_shard = batch_sharding(mesh, rules)
     repl = NamedSharding(mesh, P())
@@ -150,7 +169,8 @@ def make_train_step(cfg: TransformerConfig, optimizer: optax.GradientTransformat
         params = state["params"]
 
         def lf(p):
-            return loss_fn(cfg, p, batch, attn_fn=attn)
+            return loss_fn(cfg, p, batch, attn_fn=attn, mesh=pp_mesh,
+                           num_microbatches=num_microbatches)
 
         (loss, metrics), grads = jax.value_and_grad(lf, has_aux=True)(params)
         updates, new_opt = optimizer.update(grads, state["opt_state"], params)
