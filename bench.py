@@ -5,13 +5,20 @@ Matches BASELINE.json's metric ("Ray Train Llama tokens/sec/chip");
 >=35% MFU on the Llama LoRA fine-tune (BASELINE.md).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Runs on whatever jax.devices() offers (1 real TPU chip under the
-driver; CPU fallback shrinks the model so CI still produces a number).
+
+Robustness contract (VERDICT round 1, item 1): the TPU tunnel backend can be
+transiently unavailable, and a bare ``jax.devices()`` crash means no perf
+number at all. So the parent process runs the measurement in a CHILD process:
+try the TPU backend (with retries), then fall back to a CPU run — whichever
+child first emits a benchmark JSON line wins and the parent re-prints it.
+A JSON line is ALWAYS produced.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -21,6 +28,8 @@ PEAK_FLOPS = {
     "v2": 45e12, "v3": 123e12, "v4": 275e12,
     "v5e": 197e12, "v5p": 459e12, "v6e": 918e12, "v6p": 4614e12 / 2,
 }
+
+_CHILD_ENV = "RAY_TPU_BENCH_CHILD"
 
 
 def _peak_flops(device) -> float:
@@ -33,8 +42,30 @@ def _peak_flops(device) -> float:
     return 1e12  # CPU — MFU not meaningful, still report
 
 
-def main() -> None:
+def _run_probe() -> None:
+    """Child-process body: quick TPU viability check — backend init plus a
+    tiny compiled matmul. Bounds time-to-first-number: a hanging tunnel
+    backend costs one short probe timeout, not a full benchmark timeout."""
     import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    x = jnp.ones((128, 128), jnp.bfloat16)
+    y = jax.jit(lambda a: a @ a)(x)
+    float(jnp.float32(y[0, 0]))
+    print(f"PROBE_OK platform={dev.platform}")
+
+
+def _run_bench(platform: str) -> None:
+    """Child-process body: measure and print the JSON line."""
+    import jax
+
+    if platform == "cpu":
+        # The axon sitecustomize forces jax_platforms="axon,cpu" at import
+        # time; config.update after import wins (same trick as
+        # tests/conftest.py) and keeps us off the flaky tunnel backend.
+        jax.config.update("jax_platforms", "cpu")
+
     import jax.numpy as jnp
     import numpy as np
 
@@ -101,6 +132,72 @@ def main() -> None:
         f"mfu={mfu:.3f} step_ms={dt/iters*1e3:.1f}",
         file=sys.stderr,
     )
+
+
+def _try_child(platform: str, timeout: float) -> str | None:
+    """Run the measurement in a child process; return its JSON line or None."""
+    env = dict(os.environ, **{_CHILD_ENV: platform})
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"# bench child ({platform}) timed out", file=sys.stderr)
+        return None
+    sys.stderr.write(proc.stderr[-2000:])
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{") and '"metric"' in line:
+            return line
+    print(f"# bench child ({platform}) rc={proc.returncode}, no JSON",
+          file=sys.stderr)
+    return None
+
+
+def main() -> None:
+    child_platform = os.environ.get(_CHILD_ENV)
+    if child_platform == "probe":
+        _run_probe()
+        return
+    if child_platform:
+        _run_bench(child_platform)
+        return
+
+    # Parent: short TPU probe decides whether the tunnel backend is usable
+    # (round-1 failure mode: it HANGS rather than erroring, so committing
+    # to a full-length TPU attempt first risks never printing a number).
+    attempts = []
+    env = dict(os.environ, **{_CHILD_ENV: "probe"})
+    try:
+        probe = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, capture_output=True, text=True, timeout=240,
+        )
+        tpu_ok = "PROBE_OK" in probe.stdout and "platform=tpu" in probe.stdout
+    except subprocess.TimeoutExpired:
+        tpu_ok = False
+    if tpu_ok:
+        attempts = [("tpu", 1200.0), ("cpu", 900.0)]
+    else:
+        print("# TPU probe failed/hung — falling back to CPU", file=sys.stderr)
+        attempts = [("cpu", 900.0)]
+    for platform, timeout in attempts:
+        line = _try_child(platform, timeout)
+        if line is not None:
+            print(line)
+            return
+
+    try:
+        _run_bench("cpu")
+    except Exception as exc:  # noqa: BLE001 — a number must always appear
+        print(f"# inline CPU fallback failed: {exc!r}", file=sys.stderr)
+        print(json.dumps({
+            "metric": "train_llama_tokens_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "tokens/s/chip",
+            "vs_baseline": 0.0,
+        }))
 
 
 if __name__ == "__main__":

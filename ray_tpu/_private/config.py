@@ -67,6 +67,9 @@ _DEFINITIONS: Dict[str, Tuple[type, Any]] = {
     # how long a caller keeps resending an un-acked actor task while the
     # actor is unreachable/restarting before failing it
     "actor_task_resend_timeout_s": (float, 60.0),
+    # owner-side sweep dropping borrowers whose process died without
+    # deregistering (reference: WaitForRefRemoved, reference_counter.h:44)
+    "borrower_liveness_period_s": (float, 30.0),
     # --- tpu ---
     "tpu_chips_per_host_default": (int, 4),
     "megascale_port": (int, 8081),

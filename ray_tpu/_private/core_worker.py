@@ -356,10 +356,18 @@ class CoreWorker(CoreRuntime):
         self._blocked_depth = 0
         self._blocked_lock = threading.Lock()
 
-        # borrowed-ref registry: oid -> owner addr this process registered with
-        self._borrow_registered: Dict[ObjectID, Tuple[str, int]] = {}
+        # Borrow interest ledger. The owner keeps a borrower *set* (one
+        # entry per borrower process, idempotent add); this process sends
+        # RemoveBorrower exactly once — when its total interest in the oid
+        # (deserialized claims + unclaimed handed-off borrows) hits zero.
+        # oid -> {"owner": addr, "interest": int, "claimed": bool}
+        self._borrow_state: Dict[ObjectID, Dict[str, Any]] = {}
         # owned put-objects whose payload contains nested refs (pinned)
         self._put_contained: Dict[ObjectID, List[ObjectID]] = {}
+        # return-oid -> borrows a remote worker registered on OUR behalf
+        # (handed-off borrows; interest released at outer-ref release —
+        # advisor finding, round 1: unclaimed handoffs pinned forever)
+        self._handoff_borrows: Dict[ObjectID, List[Tuple[ObjectID, Tuple[str, int]]]] = {}
         self._borrow_lock = threading.Lock()
         from concurrent.futures import ThreadPoolExecutor as _TPE
 
@@ -369,6 +377,14 @@ class CoreWorker(CoreRuntime):
             w.reference_counter.set_borrow_release_callback(self._on_borrow_released)
 
         self._shutdown = False
+        # owner-side borrower liveness sweep (dead borrowers must not pin
+        # objects forever; reference: WaitForRefRemoved)
+        self._borrower_ping_failures: Dict[Tuple[str, int], int] = {}
+        t = threading.Thread(
+            target=self._borrower_liveness_loop, daemon=True,
+            name="borrower-sweep",
+        )
+        t.start()
 
     # ==================================================================
     # Owner-side object services
@@ -403,54 +419,205 @@ class CoreWorker(CoreRuntime):
         oid = ObjectID(object_id_bin)
         # add_borrower is atomic: it refuses to resurrect an entry for an
         # already-freed object (the borrower then gets status "freed")
-        if self._ref_counter().add_borrower(oid, tuple(borrower)):
-            return {"ok": True}
+        epoch = self._ref_counter().add_borrower(oid, tuple(borrower))
+        if epoch is not None:
+            return {"ok": True, "epoch": epoch}
         return {"ok": False, "freed": True}
 
-    def _handle_remove_borrower(self, object_id_bin: bytes, borrower: Tuple[str, int]) -> dict:
+    def _handle_remove_borrower(
+        self, object_id_bin: bytes, borrower: Tuple[str, int], epoch: int = None
+    ) -> dict:
         w = worker_mod.global_worker
         if w is not None:
-            w.reference_counter.remove_borrower(ObjectID(object_id_bin), tuple(borrower))
+            w.reference_counter.remove_borrower(
+                ObjectID(object_id_bin), tuple(borrower), epoch=epoch
+            )
         return {"ok": True}
 
     # -- borrower side (this process holds refs it does not own) --------
+    #
+    # Interest ledger: the owner keeps one registration per borrower
+    # process; this process sends RemoveBorrower once, when its total
+    # interest (claims + unclaimed handoffs) hits zero, carrying the
+    # highest registration epoch it knows — the owner discards a Remove
+    # older than its stored epoch, so a queued Remove racing a concurrent
+    # re-borrow of the same oid cannot wipe the fresh registration.
     def on_ref_created(self, oid: ObjectID, owner_addr: Tuple[str, int]) -> None:
         """Called by ObjectRef.__init__ for refs carrying an owner address.
         First sighting of a borrowed oid → synchronously register with the
         owner (synchronous so the sender's pin is still alive — closing
-        the free-before-borrow race)."""
+        the free-before-borrow race). If a handed-off borrow already
+        registered this process, only the claim is recorded locally."""
         if owner_addr == self.address or self._ref_counter().is_owned(oid):
             return
         with self._borrow_lock:
-            if oid in self._borrow_registered:
-                return
-            self._borrow_registered[oid] = owner_addr
-        try:
-            get_client(owner_addr).call(
-                "AddBorrower", object_id_bin=oid.binary(), borrower=self.address,
-                timeout=10,
-            )
-        except Exception:
-            pass  # owner gone: get() will surface ObjectLostError
+            st = self._borrow_state.get(oid)
+            if st is None:
+                st = {"owner": owner_addr, "interest": 0, "claimed": False,
+                      "epoch": 0}
+                self._borrow_state[oid] = st
+                need_send = True
+            else:
+                if st["claimed"]:
+                    return
+                need_send = False
+            st["claimed"] = True
+            st["interest"] += 1
 
-    def _on_borrow_released(self, oid: ObjectID) -> None:
+        if need_send:
+            try:
+                rep = get_client(owner_addr).call(
+                    "AddBorrower", object_id_bin=oid.binary(),
+                    borrower=self.address, timeout=10,
+                )
+                self._note_borrow_epoch(oid, (rep or {}).get("epoch"))
+            except Exception:
+                pass  # owner gone: get() will surface ObjectLostError
+
+    def _note_borrow_epoch(self, oid: ObjectID, epoch) -> None:
+        if epoch is None:
+            return
         with self._borrow_lock:
-            owner = self._borrow_registered.pop(oid, None)
-        if owner is None:
+            st = self._borrow_state.get(oid)
+            if st is not None and epoch > st["epoch"]:
+                st["epoch"] = epoch
+
+    @staticmethod
+    def _parse_borrow(entry) -> Tuple[ObjectID, Tuple[str, int], int]:
+        # wire format: (oid_bin, owner_addr, epoch); epoch 0 = unknown
+        b, addr, epoch = entry
+        return ObjectID(b), tuple(addr), int(epoch or 0)
+
+    def _record_handoff_borrows(self, outer: ObjectID, ret: dict) -> None:
+        borrows = ret.get("borrows")
+        if not borrows:
+            return
+        pairs = [self._parse_borrow(e) for e in borrows]
+        with self._borrow_lock:
+            for inner, owner, epoch in pairs:
+                st = self._borrow_state.get(inner)
+                if st is None:
+                    self._borrow_state[inner] = {
+                        "owner": owner, "interest": 1, "claimed": False,
+                        "epoch": epoch,
+                    }
+                else:
+                    st["interest"] += 1
+                    if epoch > st["epoch"]:
+                        st["epoch"] = epoch
+            # Fire-and-forget ordering: the outer return ref can already be
+            # released before the reply lands — then free_object has already
+            # run and nothing will ever pop this entry. Release now.
+            if self._ref_counter().has_reference(outer):
+                self._handoff_borrows[outer] = pairs
+                pairs = None
+        if pairs:
+            self._dec_borrow_interest([p[0] for p in pairs])
+
+    def _release_unclaimed_handoffs(self, outer: ObjectID) -> None:
+        """Outer return ref released: drop one interest unit per nested
+        handed-off borrow (claims hold their own unit)."""
+        with self._borrow_lock:
+            pairs = self._handoff_borrows.pop(outer, None)
+        if pairs:
+            self._dec_borrow_interest([p[0] for p in pairs])
+
+    def _absorb_dropped_handoffs(self, reply: dict) -> None:
+        """A reply we will never hand to the user (late/failed/retried task)
+        may still carry borrows an executing worker registered on our
+        behalf; deregister any the ledger has no interest in."""
+        dropped = list(reply.get("dropped_borrows") or [])
+        for ret in reply.get("returns") or []:
+            dropped.extend(ret.get("borrows") or [])
+        if not dropped:
+            return
+        to_remove = []
+        with self._borrow_lock:
+            for entry in dropped:
+                inner, owner, epoch = self._parse_borrow(entry)
+                st = self._borrow_state.get(inner)
+                if st is None:
+                    to_remove.append((inner, owner, epoch))
+                elif epoch > st["epoch"]:
+                    st["epoch"] = epoch  # ledger covers it; track epoch
+        self._queue_remove_borrowers(to_remove)
+
+    def _dec_borrow_interest(self, oids: List[ObjectID]) -> None:
+        to_remove = []
+        with self._borrow_lock:
+            for oid in oids:
+                st = self._borrow_state.get(oid)
+                if st is None:
+                    continue
+                st["interest"] -= 1
+                if st["interest"] <= 0:
+                    del self._borrow_state[oid]
+                    to_remove.append((oid, st["owner"], st["epoch"]))
+        self._queue_remove_borrowers(to_remove)
+
+    def _queue_remove_borrowers(
+        self, pairs: List[Tuple[ObjectID, Tuple[str, int], int]]
+    ) -> None:
+        if not pairs:
             return
 
-        # network send off-thread: this is called from ObjectRef.__del__
-        # paths where a dead owner's connect timeout must not stall the
-        # releasing thread
         def _send():
+            for inner, owner, epoch in pairs:
+                with self._borrow_lock:
+                    if inner in self._borrow_state:
+                        continue  # re-borrowed since queued; still live
+                try:
+                    get_client(owner).call_oneway(
+                        "RemoveBorrower", object_id_bin=inner.binary(),
+                        borrower=self.address, epoch=epoch or None,
+                    )
+                except Exception:
+                    pass
+
+        self._borrow_release_pool.submit(_send)
+
+    def _borrower_liveness_loop(self) -> None:
+        period = max(1.0, config.borrower_liveness_period_s)
+        while not self._shutdown:
+            time.sleep(period)
             try:
-                get_client(owner).call_oneway(
-                    "RemoveBorrower", object_id_bin=oid.binary(), borrower=self.address
-                )
+                self._borrower_liveness_sweep()
             except Exception:
                 pass
 
-        self._borrow_release_pool.submit(_send)
+    def _borrower_liveness_sweep(self) -> None:
+        # remove_borrower is irreversible, and a live-but-busy borrower
+        # (GIL held by a multi-GB deserialize, host pause) can miss pings:
+        # require 3 consecutive failures with generous timeouts (~90s of
+        # silence at the default 30s period) before declaring it dead.
+        rc = self._ref_counter()
+        by_addr = rc.borrower_addrs()
+        for addr in list(self._borrower_ping_failures):
+            if addr not in by_addr:
+                self._borrower_ping_failures.pop(addr, None)
+        for addr, oids in by_addr.items():
+            try:
+                get_client(addr).call("Ping", timeout=10)
+                self._borrower_ping_failures.pop(addr, None)
+            except Exception:
+                n = self._borrower_ping_failures.get(addr, 0) + 1
+                self._borrower_ping_failures[addr] = n
+                if n >= 3:
+                    self._borrower_ping_failures.pop(addr, None)
+                    for oid in oids:
+                        rc.remove_borrower(oid, addr)
+
+    def _on_borrow_released(self, oid: ObjectID) -> None:
+        """Last local ObjectRef for a borrowed oid died → drop the claim's
+        interest unit. The RemoveBorrower (if interest hits zero) goes out
+        on the pool thread: this is called from ObjectRef.__del__ paths
+        where a dead owner's connect timeout must not stall the releaser."""
+        with self._borrow_lock:
+            st = self._borrow_state.get(oid)
+            if st is None or not st["claimed"]:
+                return
+            st["claimed"] = False
+        self._dec_borrow_interest([oid])
 
     # ==================================================================
     # Objects
@@ -658,6 +825,7 @@ class CoreWorker(CoreRuntime):
             inner = self._put_contained.pop(oid, None)
         if inner:
             self._release_contained_refs(inner)
+        self._release_unclaimed_handoffs(oid)
         e = self.memory_store.get_if_exists(oid)
         self.memory_store.delete(oid)
         with self._pin_lock:
@@ -968,15 +1136,21 @@ class CoreWorker(CoreRuntime):
     def _complete_task(self, spec: TaskSpec, reply: dict) -> None:
         returns = reply.get("returns", [])
         retriable_error = reply.get("retriable_error")
+        if reply.get("dropped_borrows"):
+            # borrows registered for values that failed to package — the
+            # error reply supersedes them (advisor/review finding, round 2)
+            self._absorb_dropped_handoffs({"dropped_borrows": reply["dropped_borrows"]})
         if retriable_error and spec.retry_exceptions:
             st = self._pending_tasks.get(spec.task_id)
             if st is not None and st["retries_left"] > 0:
                 st["retries_left"] -= 1
                 spec.attempt_number += 1
+                self._absorb_dropped_handoffs({"returns": returns})
                 self.loop_thread.call_soon(self._submit_spec_threadsafe, spec)
                 return
         for i, ret in enumerate(returns):
             oid = ObjectID.from_index(spec.task_id, i + 1)
+            self._record_handoff_borrows(oid, ret)
             if ret["kind"] == "inline":
                 self.memory_store.put(oid, ("inline", ret["data"]))
             else:
@@ -1119,17 +1293,26 @@ class CoreWorker(CoreRuntime):
                 self._actor_dispatchers[aid] = disp
             return disp
 
-    def _handle_actor_task_done(self, task_id_bin: bytes, returns: List[dict]) -> dict:
+    def _handle_actor_task_done(
+        self, task_id_bin: bytes, returns: List[dict], dropped_borrows: list = None
+    ) -> dict:
         """Execution result pushed back by the actor's worker."""
         tid = TaskID(task_id_bin)
+        if dropped_borrows:
+            self._absorb_dropped_handoffs({"dropped_borrows": dropped_borrows})
         with self._actor_pending_lock:
             info = self._pending_actor_tasks.pop(tid, None)
             contained = self._actor_task_contained.pop(tid, [])
         self._release_contained_refs(contained)
         if info is None:
-            return {"ok": False}  # already failed (restart) — drop late result
+            # already failed (restart) — drop the late result, but the
+            # executing worker still registered us as borrower of any refs
+            # nested in it; deregister them or the owners pin forever
+            self._absorb_dropped_handoffs({"returns": returns})
+            return {"ok": False}
         for i, ret in enumerate(returns):
             oid = info["return_oids"][i]
+            self._record_handoff_borrows(oid, ret)
             if ret["kind"] == "inline":
                 self.memory_store.put(oid, ("inline", ret["data"]))
             else:

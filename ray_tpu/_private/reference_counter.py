@@ -24,10 +24,14 @@ from ray_tpu._private.ids import ObjectID
 class _Ref:
     local_refs: int = 0
     submitted_task_refs: int = 0
-    # owner side: borrower core-worker address -> number of outstanding
-    # borrow registrations from that process (a borrower deregisters all
-    # of them at once when its last local ref dies)
+    # owner side: borrower address -> epoch of its latest AddBorrower.
+    # A borrower sends RemoveBorrower (carrying the highest epoch it knows)
+    # once its total interest — deserialized claims + unclaimed handoffs —
+    # hits zero; the owner ignores a Remove older than the stored epoch, so
+    # a stale Remove racing a concurrent re-borrow cannot wipe a live
+    # registration (round-2 review finding).
     borrowers: Dict[Tuple[str, int], int] = field(default_factory=dict)
+    borrow_epoch: int = 0
     owned: bool = False
     lineage_pinned: bool = False
     pending_creation: bool = False
@@ -102,22 +106,48 @@ class ReferenceCounter:
     # -- borrowers (owner side; reference: reference_counter.h:44 borrower
     # bookkeeping — a borrower process registers before it may read, the
     # owner keeps the object alive until every borrower deregisters) -----
-    def add_borrower(self, oid: ObjectID, borrower_addr: Tuple[str, int]) -> bool:
-        """Owner side. Returns False (no entry created) when the object's
-        ref entry is already gone — i.e. the object was freed; recreating
-        a zombie entry would make readers see 'pending' forever."""
+    def add_borrower(self, oid: ObjectID, borrower_addr: Tuple[str, int]) -> Optional[int]:
+        """Owner side. Returns the registration epoch, or None when the
+        object's ref entry is already gone — i.e. the object was freed;
+        recreating a zombie entry would make readers see 'pending' forever."""
         with self._lock:
             r = self._refs.get(oid)
             if r is None:
-                return False
-            r.borrowers[borrower_addr] = r.borrowers.get(borrower_addr, 0) + 1
-            return True
+                return None
+            r.borrow_epoch += 1
+            r.borrowers[borrower_addr] = r.borrow_epoch
+            return r.borrow_epoch
 
-    def remove_borrower(self, oid: ObjectID, borrower_addr: Tuple[str, int]) -> None:
+    def borrower_addrs(self) -> Dict[Tuple[str, int], Set[ObjectID]]:
+        """Owner side: every registered borrower address -> oids it pins.
+        Used by the core worker's liveness sweep to drop borrowers whose
+        process died without deregistering (reference: WaitForRefRemoved,
+        reference_counter.h:44)."""
+        out: Dict[Tuple[str, int], Set[ObjectID]] = {}
+        with self._lock:
+            for oid, r in self._refs.items():
+                for addr in r.borrowers:
+                    out.setdefault(addr, set()).add(oid)
+        return out
+
+    def remove_borrower(
+        self,
+        oid: ObjectID,
+        borrower_addr: Tuple[str, int],
+        epoch: Optional[int] = None,
+    ) -> None:
+        """Owner side. ``epoch=None`` removes unconditionally (borrower
+        death); otherwise the removal only applies if no newer AddBorrower
+        from that address has been recorded since."""
         with self._lock:
             r = self._refs.get(oid)
             if r is None:
                 return
+            stored = r.borrowers.get(borrower_addr)
+            if stored is None:
+                return
+            if epoch is not None and stored > epoch:
+                return  # stale remove: a newer registration exists
             r.borrowers.pop(borrower_addr, None)
             action = self._maybe_release(oid, r)
         self._run_release_action(action, oid)

@@ -107,6 +107,7 @@ class _ActorRunner:
                     "ActorTaskDone",
                     task_id_bin=task_bin,
                     returns=result["returns"],
+                    dropped_borrows=result.get("dropped_borrows") or [],
                     timeout=30,
                 )
                 with self.lock:
@@ -155,6 +156,7 @@ def _execute_callable(
 
     w = worker_mod.global_worker
     w.set_task_context(task_id, actor_id)
+    all_borrows: List[tuple] = []  # every AddBorrower sent for this task
     try:
         args, kwargs = _resolve_args(packed_args, packed_kwargs)
         result = fn(args, kwargs)
@@ -171,30 +173,42 @@ def _execute_callable(
             # refs nested in the return value: register the CALLER as
             # borrower with each owner BEFORE replying, while our own
             # refs still pin the objects (reference_counter.h:44 —
-            # borrower handoff on task return)
+            # borrower handoff on task return). The registered handoffs
+            # ride back in the reply ("borrows") so the caller can
+            # deregister any it never claims by deserializing (advisor
+            # finding, round 1: unclaimed handoffs pinned forever).
+            borrows = []
             if col.refs and caller_addr is not None:
                 for r in col.refs:
                     owner = r.owner_address or w.core.address
                     if tuple(owner) == tuple(caller_addr):
                         continue  # caller owns it already
                     try:
-                        get_client(tuple(owner)).call(
+                        rep = get_client(tuple(owner)).call(
                             "AddBorrower",
                             object_id_bin=r.id().binary(),
                             borrower=tuple(caller_addr),
                             timeout=10,
                         )
+                        entry = (
+                            r.id().binary(), tuple(owner),
+                            (rep or {}).get("epoch") or 0,
+                        )
+                        borrows.append(entry)
+                        all_borrows.append(entry)
                     except Exception:
                         pass
             if len(data) <= config.object_store_inline_max_bytes:
-                returns.append({"kind": "inline", "data": data})
+                returns.append({"kind": "inline", "data": data, "borrows": borrows})
             else:
                 oid = ObjectID.from_index(task_id, i + 1)
                 try:
                     w.core.plasma.put_bytes(oid, data)
                 except FileExistsError:
                     pass
-                returns.append({"kind": "plasma", "node_id": w.core.node_id})
+                returns.append(
+                    {"kind": "plasma", "node_id": w.core.node_id, "borrows": borrows}
+                )
         return {"returns": returns}
     except BaseException as e:  # noqa: BLE001
         tb = traceback.format_exc()
@@ -203,6 +217,10 @@ def _execute_callable(
         return {
             "returns": [{"kind": "inline", "data": data} for _ in range(num_returns)],
             "retriable_error": True,
+            # borrows registered before the failure (e.g. value 0 packaged,
+            # value 1 raised): report them so the caller's ledger can
+            # deregister — the error reply drops the values they rode in on
+            "dropped_borrows": all_borrows,
         }
     finally:
         w.set_task_context(None, None)

@@ -139,7 +139,7 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
     h, m, v, l = cfg.hidden, cfg.mlp_hidden, cfg.vocab_size, cfg.layers
     hd, nh, nkv = cfg.hd, cfg.heads, cfg.kv_heads
     pd = cfg.param_dtype
-    keys = jax.random.split(key, 12)
+    keys = jax.random.split(key, 13)
 
     def stack(k, shape, fan_in):
         ks = jax.random.split(k, l)
@@ -169,7 +169,9 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
         "ln_f": jnp.ones((h,), pd),
     }
     if not cfg.tie_embeddings:
-        params["unembed"] = _dense_init(keys[8], (h, v), pd, h)
+        # keys[12]: own key — keys[8] seeds the MoE wo_mlp stack, and
+        # sharing it would correlate the two inits (advisor finding, r1)
+        params["unembed"] = _dense_init(keys[12], (h, v), pd, h)
     if cfg.lora_rank:
         r = cfg.lora_rank
         def lz(shape):  # LoRA B starts at zero

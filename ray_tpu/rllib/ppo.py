@@ -122,10 +122,17 @@ class EnvRunner:
         self.episode_return = 0.0
         self.completed_returns: List[float] = []
 
+    def _value(self, obs, params_np: Dict) -> float:
+        v = obs
+        for i in range(self.n_hidden):
+            v = np.tanh(v @ params_np["vf"][f"w{i}"] + params_np["vf"][f"b{i}"])
+        return float((v @ params_np["vf"]["head_w"] + params_np["vf"]["head_b"])[0])
+
     def sample(self, params_np: Dict, num_steps: int) -> Dict[str, np.ndarray]:
         """Collect a fragment with the given policy weights (numpy inference
         on CPU — tiny nets; the TPU does the learning)."""
         obs_buf, act_buf, rew_buf, done_buf, logp_buf, val_buf = [], [], [], [], [], []
+        trunc_buf, boot_buf = [], []
         for _ in range(num_steps):
             h = self.obs
             for i in range(self.n_hidden):
@@ -134,10 +141,7 @@ class EnvRunner:
             z = logits - logits.max()
             p = np.exp(z) / np.exp(z).sum()
             a = int(self.rng.choice(len(p), p=p))
-            v = self.obs
-            for i in range(self.n_hidden):
-                v = np.tanh(v @ params_np["vf"][f"w{i}"] + params_np["vf"][f"b{i}"])
-            val = float((v @ params_np["vf"]["head_w"] + params_np["vf"]["head_b"])[0])
+            val = self._value(self.obs, params_np)
 
             nobs, rew, term, trunc, _ = self.env.step(a)
             obs_buf.append(self.obs)
@@ -146,6 +150,13 @@ class EnvRunner:
             done_buf.append(term)
             logp_buf.append(np.log(p[a] + 1e-10))
             val_buf.append(val)
+            truncated = bool(trunc and not term)
+            trunc_buf.append(truncated)
+            # a truncated (not terminated) episode bootstraps from V(s_T)
+            # of the state it was cut off at, computed BEFORE the reset
+            # (reference rllib postprocessing: truncations bootstrap with
+            # the value of the final observation — advisor finding, r1)
+            boot_buf.append(self._value(nobs, params_np) if truncated else 0.0)
             self.episode_return += rew
             if term or trunc:
                 self.completed_returns.append(self.episode_return)
@@ -154,10 +165,7 @@ class EnvRunner:
             else:
                 self.obs = nobs
         # bootstrap value for the final state
-        v = self.obs
-        for i in range(self.n_hidden):
-            v = np.tanh(v @ params_np["vf"][f"w{i}"] + params_np["vf"][f"b{i}"])
-        last_val = float((v @ params_np["vf"]["head_w"] + params_np["vf"]["head_b"])[0])
+        last_val = self._value(self.obs, params_np)
         rets = self.completed_returns
         self.completed_returns = []
         return {
@@ -165,6 +173,8 @@ class EnvRunner:
             "actions": np.asarray(act_buf, np.int32),
             "rewards": np.asarray(rew_buf, np.float32),
             "dones": np.asarray(done_buf, np.bool_),
+            "truncs": np.asarray(trunc_buf, np.bool_),
+            "bootstrap_values": np.asarray(boot_buf, np.float32),
             "logp": np.asarray(logp_buf, np.float32),
             "values": np.asarray(val_buf, np.float32),
             "last_value": np.float32(last_val),
@@ -172,17 +182,26 @@ class EnvRunner:
         }
 
 
-def compute_gae(rewards, values, dones, last_value, gamma, lambda_):
+def compute_gae(rewards, values, dones, last_value, gamma, lambda_,
+                truncs=None, bootstrap_values=None):
     """Generalized advantage estimation (reference:
-    rllib/evaluation/postprocessing.py compute_advantages)."""
+    rllib/evaluation/postprocessing.py compute_advantages).
+
+    Truncated-but-not-terminated steps bootstrap from V(s_{t+1}) recorded
+    before the env reset, and the lambda accumulation stops at the boundary
+    (the following buffer row belongs to a different episode)."""
     T = len(rewards)
     adv = np.zeros(T, np.float32)
     last = 0.0
     next_v = last_value
     for t in reversed(range(T)):
-        nonterminal = 1.0 - float(dones[t])
-        delta = rewards[t] + gamma * next_v * nonterminal - values[t]
-        last = delta + gamma * lambda_ * nonterminal * last
+        if truncs is not None and truncs[t]:
+            delta = rewards[t] + gamma * float(bootstrap_values[t]) - values[t]
+            last = delta
+        else:
+            nonterminal = 1.0 - float(dones[t])
+            delta = rewards[t] + gamma * next_v * nonterminal - values[t]
+            last = delta + gamma * lambda_ * nonterminal * last
         adv[t] = last
         next_v = values[t]
     returns = adv + values
@@ -296,6 +315,7 @@ class PPO:
             adv, rets = compute_gae(
                 f["rewards"], f["values"], f["dones"], f["last_value"],
                 cfg.gamma, cfg.lambda_,
+                truncs=f.get("truncs"), bootstrap_values=f.get("bootstrap_values"),
             )
             parts.append(dict(f, adv=adv, returns=rets))
             self._recent_returns.extend(f["episode_returns"].tolist())

@@ -40,6 +40,13 @@ class Checkpoint:
 
     # -- metrics sidecar -------------------------------------------------
     def update_metadata(self, metadata: Dict[str, Any]) -> None:
+        """Merge ``metadata`` into the existing metadata (reference:
+        train/_checkpoint.py:169 — update merges; set_metadata overwrites)."""
+        merged = self.get_metadata()
+        merged.update(metadata)
+        self.set_metadata(merged)
+
+    def set_metadata(self, metadata: Dict[str, Any]) -> None:
         with open(os.path.join(self.path, ".metadata.json"), "w") as f:
             json.dump(metadata, f)
 

@@ -36,17 +36,28 @@ class _Rendezvous:
     def __init__(self, world_size: int):
         self.world_size = world_size
         self._slots: Dict[Tuple[str, int], Dict[int, Any]] = {}
+        self._collected: Dict[Tuple[str, int], set] = {}
 
     def put(self, key: str, seq: int, rank: int, ref: Any):
         slot = self._slots.setdefault((key, seq), {})
         slot[rank] = ref
         return len(slot)
 
-    def collect(self, key: str, seq: int) -> Optional[List[Any]]:
+    def collect(self, key: str, seq: int, rank: int = -1) -> Optional[List[Any]]:
         slot = self._slots.get((key, seq), {})
         if len(slot) < self.world_size:
             return None
-        return [slot[r] for r in range(self.world_size)]
+        out = [slot[r] for r in range(self.world_size)]
+        # Auto-gc once EVERY rank has collected. (An eager rank-0 gc races
+        # with slower ranks, which would then see an empty slot forever and
+        # time out — advisor finding, round 1.)
+        if rank >= 0:
+            done = self._collected.setdefault((key, seq), set())
+            done.add(rank)
+            if len(done) >= self.world_size:
+                self._slots.pop((key, seq), None)
+                self._collected.pop((key, seq), None)
+        return out
 
     def collect_from(self, key: str, seq: int, rank: int) -> Optional[Any]:
         """P2P: fetch a single rank's contribution (and clear it)."""
@@ -101,12 +112,9 @@ class ObjStoreGroup:
         ray_tpu.get(self._rdv.put.remote(key, seq, self.rank, [ref]))
         deadline = time.time() + 120.0
         while time.time() < deadline:
-            refs = ray_tpu.get(self._rdv.collect.remote(key, seq))
+            refs = ray_tpu.get(self._rdv.collect.remote(key, seq, self.rank))
             if refs is not None:
-                out = [ray_tpu.get(r[0]) for r in refs]
-                if self.rank == 0:
-                    self._rdv.gc.remote(key, seq)
-                return out
+                return [ray_tpu.get(r[0]) for r in refs]
             time.sleep(0.002)
         raise TimeoutError(f"collective {key} timed out (seq={seq})")
 

@@ -193,3 +193,36 @@ def test_actor_restart_after_worker_death(cluster):
             time.sleep(0.5)  # restart in progress; lost-task failures OK
     assert val == 1, f"expected fresh state after restart, got {val}"
     assert ray_tpu.get(p.pid.remote(), timeout=30) != old_pid
+
+
+def test_borrow_handoff_claimed_and_unclaimed(cluster):
+    """Borrow-interest ledger (reference: reference_counter.h:44 borrower
+    handoff): two tasks hand off the SAME worker-owned ref; releasing one
+    outer return unclaimed must not unpin the other's handoff, and the
+    inner object must stay readable until all interest is gone."""
+
+    @ray_tpu.remote
+    def make_inner():
+        return ray_tpu.put(np.arange(1000))
+
+    inner_holder = {}
+
+    @ray_tpu.remote
+    def wrap(boxed):
+        # boxed is a list whose element is an (unresolved) nested ref
+        return {"inner": boxed[0]}
+
+    inner = make_inner.remote()
+    inner_ref = ray_tpu.get(inner, timeout=60)  # worker-owned ref
+    del inner
+    outer1 = wrap.remote([inner_ref])
+    outer2 = wrap.remote([inner_ref])
+    del inner_ref
+    time.sleep(0.5)
+    # release outer1 WITHOUT deserializing: its handoff interest drops,
+    # but outer2 still pins the inner object
+    ray_tpu.get(outer2, timeout=60)  # ensure both replies landed
+    del outer1
+    time.sleep(1.0)
+    val = ray_tpu.get(ray_tpu.get(outer2, timeout=60)["inner"], timeout=60)
+    assert val.sum() == 499500
