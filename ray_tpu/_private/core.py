@@ -47,6 +47,10 @@ class ActorOptions:
     get_if_exists: bool = False
     scheduling_strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)
     runtime_env: Dict[str, Any] = field(default_factory=dict)
+    # Reference semantics (actor.py:616+ docs): an actor whose num_cpus was
+    # NOT specified uses 1 CPU for *scheduling* its creation but holds 0 CPU
+    # while alive — otherwise long-lived actors starve task leases.
+    cpu_scheduling_only: bool = True
 
 
 def normalize_resources(

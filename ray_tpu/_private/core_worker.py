@@ -970,7 +970,8 @@ class CoreWorker(CoreRuntime):
                 timeout=config.worker_lease_timeout_ms / 1000.0 + 10.0,
             )
         except Exception as e:  # noqa: BLE001
-            logger.warning("lease request failed: %s", e)
+            if not self._shutdown:
+                logger.warning("lease request failed: %s", e)
             reply = {"granted": False, "error": str(e)}
         finally:
             with self._lock:
@@ -985,7 +986,7 @@ class CoreWorker(CoreRuntime):
             else:
                 # re-kick if tasks remain
                 with self._lock:
-                    remaining = bool(self._task_queue.get(sc))
+                    remaining = bool(self._task_queue.get(sc)) and not self._shutdown
                 if remaining:
                     import asyncio
 
@@ -1211,6 +1212,7 @@ class CoreWorker(CoreRuntime):
             get_if_exists=opts.get_if_exists,
             pg_id=strategy.placement_group_id,
             bundle_index=strategy.placement_group_bundle_index,
+            cpu_scheduling_only=opts.cpu_scheduling_only,
         )
         if "error" in reply:
             raise ValueError(reply["error"])

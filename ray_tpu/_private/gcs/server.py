@@ -64,6 +64,9 @@ class ActorInfo:
     version: int = 0  # bumped on every state change
     pg_id: Optional[str] = None
     bundle_index: int = -1
+    # num_cpus defaulted: CPU counts for scheduling creation only, not held
+    # while alive (reference actor resource semantics)
+    cpu_scheduling_only: bool = False
 
 
 @dataclass
@@ -291,6 +294,7 @@ class GcsServer:
         get_if_exists: bool = False,
         pg_id: Optional[str] = None,
         bundle_index: int = -1,
+        cpu_scheduling_only: bool = False,
     ) -> dict:
         if name:
             existing = self.named_actors.get((namespace, name))
@@ -313,6 +317,7 @@ class GcsServer:
             detached=detached,
             pg_id=pg_id,
             bundle_index=bundle_index,
+            cpu_scheduling_only=cpu_scheduling_only,
         )
         self.actors[actor_id] = actor
         if name:
@@ -374,6 +379,7 @@ class GcsServer:
                     pg_id=actor.pg_id,
                     bundle_index=actor.bundle_index,
                     lease_timeout=50.0,
+                    release_cpu_after_grant=actor.cpu_scheduling_only,
                     timeout=60,
                 )
             except Exception as e:  # noqa: BLE001
@@ -398,6 +404,15 @@ class GcsServer:
                 worker.close()
             except Exception as e:  # noqa: BLE001
                 logger.warning("actor %s creation push failed: %s", actor.actor_id[:12], e)
+                # the worker may still be running __init__ — return the lease
+                # with worker_dead=True (kills the worker) so the retry can't
+                # produce a second live instance and the lease isn't leaked
+                try:
+                    await self._raylet(node_id).acall(
+                        "ReturnWorkerLease", lease_id=reply["lease_id"], worker_dead=True
+                    )
+                except Exception:
+                    pass
                 await asyncio.sleep(0.5)
                 continue
             if creation_reply.get("ok"):

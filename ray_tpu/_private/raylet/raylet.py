@@ -95,6 +95,7 @@ class Lease:
     job_id: str
     for_actor: Optional[str] = None
     blocked: bool = False  # worker is blocked in get(); CPU released
+    cpu_released: bool = False  # actor lease: CPU returned after grant
 
 
 @dataclass
@@ -136,6 +137,10 @@ class Raylet:
         self.prepared_bundles: Dict[Tuple[str, int], Dict[str, Any]] = {}
         self.committed_bundles: Dict[Tuple[str, int], "ResourceSet"] = {}
         self._starting_workers = 0
+        # single-consumer drain: _drain_pending rebuilds self.pending and
+        # must never run reentrantly (two interleaved drains clobber each
+        # other's rebuild); callers kick the event instead of calling it
+        self._drain_wakeup: Optional[asyncio.Event] = None
 
     # ------------------------------------------------------------------
     # Worker pool (reference: worker_pool.h:280)
@@ -215,6 +220,7 @@ class Raylet:
         pg_id: Optional[str] = None,
         bundle_index: int = -1,
         lease_timeout: float = 25.0,
+        release_cpu_after_grant: bool = False,
     ) -> dict:
         req = {
             "resources": dict(resources),
@@ -223,6 +229,7 @@ class Raylet:
             "for_actor": for_actor,
             "pg_id": pg_id,
             "bundle_index": bundle_index,
+            "release_cpu_after_grant": release_cpu_after_grant,
         }
         logger.debug(
             "lease request %s avail=%s idle=%d workers=%d",
@@ -234,7 +241,7 @@ class Raylet:
         grant = await self._try_grant(req)
         if grant is not None:
             return grant
-        rs = self._resource_set_for(req)
+        rs, _ = self._resource_set_for(req)
         if not rs.feasible(self._cpu_only(req["resources"], pg_id)):
             return {
                 "granted": False,
@@ -257,23 +264,25 @@ class Raylet:
     def _cpu_only(self, resources: Dict[str, float], pg_id: Optional[str]) -> Dict[str, float]:
         return dict(resources)
 
-    def _resource_set_for(self, req: dict) -> ResourceSet:
+    def _resource_set_for(self, req: dict) -> Tuple[ResourceSet, Optional[Tuple[str, int]]]:
+        """Returns (resource_set, committed_bundle_key). The key is the
+        RESOLVED bundle (never index -1) so release finds the same set."""
         pg_id = req.get("pg_id")
         if pg_id:
             key = (pg_id, req.get("bundle_index", -1))
             if key in self.committed_bundles:
-                return self.committed_bundles[key]
+                return self.committed_bundles[key], key
             # bundle_index -1: any committed bundle of that pg with room
             for (p, idx), rs in self.committed_bundles.items():
                 if p == pg_id and rs.can_fit(req["resources"]):
-                    return rs
+                    return rs, (p, idx)
             for (p, idx), rs in self.committed_bundles.items():
                 if p == pg_id:
-                    return rs
-        return self.resources
+                    return rs, (p, idx)
+        return self.resources, None
 
     async def _try_grant(self, req: dict) -> Optional[dict]:
-        rs = self._resource_set_for(req)
+        rs, pg_key = self._resource_set_for(req)
         # allocate BEFORE any await: resource accounting is what bounds
         # concurrent lease grants (and worker spawns) on this node
         alloc = rs.allocate(req["resources"])
@@ -283,7 +292,7 @@ class Raylet:
         if worker is None:
             rs.release(alloc)
             return None
-        alloc["from_pg"] = (req.get("pg_id"), req.get("bundle_index")) if req.get("pg_id") else None
+        alloc["from_pg"] = pg_key
         lease_id = uuid.uuid4().hex
         lease = Lease(
             lease_id=lease_id,
@@ -311,6 +320,15 @@ class Raylet:
             logger.warning("failed to set lease context on worker: %s", e)
             self._release_lease(lease, worker_dead=True)
             return None
+        if req.get("release_cpu_after_grant"):
+            # actor with defaulted num_cpus: CPU was only a scheduling
+            # requirement — hand it back so long-lived actors don't starve
+            # task leases (reference: actors hold 0 CPU while alive)
+            cpu = alloc["resources"].get("CPU", 0.0)
+            if cpu:
+                lease.cpu_released = True
+                rs.available["CPU"] = rs.available.get("CPU", 0.0) + cpu
+                self._kick_drain()
         return {
             "granted": True,
             "lease_id": lease_id,
@@ -322,8 +340,9 @@ class Raylet:
     def _release_lease(self, lease: Lease, worker_dead: bool) -> None:
         rs = self._rs_for_lease(lease)
         alloc = lease.alloc
-        if lease.blocked:
-            # the CPU share was already released when the worker blocked
+        if lease.blocked or lease.cpu_released:
+            # the CPU share was already released (worker blocked in get(),
+            # or an actor lease that only used CPU for scheduling)
             res = dict(alloc["resources"])
             res.pop("CPU", None)
             alloc = dict(alloc, resources=res)
@@ -351,10 +370,10 @@ class Raylet:
         if lease is not None and not lease.blocked:
             lease.blocked = True
             cpu = lease.alloc["resources"].get("CPU", 0.0)
-            if cpu:
+            if cpu and not lease.cpu_released:
                 rs = self._rs_for_lease(lease)
                 rs.available["CPU"] = rs.available.get("CPU", 0.0) + cpu
-            await self._drain_pending()
+            self._kick_drain()
         return {"ok": True}
 
     async def NotifyWorkerUnblocked(self, lease_id: str) -> dict:
@@ -362,7 +381,7 @@ class Raylet:
         if lease is not None and lease.blocked:
             lease.blocked = False
             cpu = lease.alloc["resources"].get("CPU", 0.0)
-            if cpu:
+            if cpu and not lease.cpu_released:
                 # may go negative: transient oversubscription, like the
                 # reference's cpu-borrowing on unblock
                 rs = self._rs_for_lease(lease)
@@ -380,7 +399,7 @@ class Raylet:
         if lease is None:
             return {"ok": False}
         self._release_lease(lease, worker_dead)
-        await self._drain_pending()
+        self._kick_drain()
         return {"ok": True}
 
     def _undo_grant(self, grant: dict) -> None:
@@ -388,6 +407,24 @@ class Raylet:
         lease = self.leases.get(grant["lease_id"])
         if lease is not None:
             self._release_lease(lease, worker_dead=False)
+
+    def _kick_drain(self) -> None:
+        if self._drain_wakeup is not None:
+            self._drain_wakeup.set()
+
+    async def _drain_loop(self) -> None:
+        """Sole consumer of self.pending — see _drain_wakeup comment."""
+        self._drain_wakeup = asyncio.Event()
+        while True:
+            try:
+                await asyncio.wait_for(self._drain_wakeup.wait(), timeout=0.5)
+            except asyncio.TimeoutError:
+                pass
+            self._drain_wakeup.clear()
+            try:
+                await self._drain_pending()
+            except Exception:  # noqa: BLE001
+                logger.exception("pending-lease drain failed")
 
     async def _drain_pending(self) -> None:
         still: List[PendingLease] = []
@@ -442,7 +479,7 @@ class Raylet:
         rs = self.committed_bundles.pop((pg_id, bundle_index), None)
         if rs is not None and hasattr(rs, "_node_alloc"):
             self.resources.release(rs._node_alloc)
-        await self._drain_pending()
+        self._kick_drain()
         return {"ok": True}
 
     # ------------------------------------------------------------------
@@ -508,7 +545,7 @@ class Raylet:
                             )
                         except Exception:
                             pass
-            await self._drain_pending()
+            self._kick_drain()
 
     async def _idle_reaper_loop(self) -> None:
         while True:
@@ -554,6 +591,7 @@ class Raylet:
         asyncio.ensure_future(self._heartbeat_loop())
         asyncio.ensure_future(self._reap_loop())
         asyncio.ensure_future(self._idle_reaper_loop())
+        asyncio.ensure_future(self._drain_loop())
         if config.worker_pool_prestart_workers:
             for _ in range(int(self.resources.total.get("CPU", 1))):
                 self._spawn_worker()
@@ -589,6 +627,22 @@ def main() -> None:
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args()
     logging.basicConfig(level=args.log_level, format="[raylet] %(levelname)s %(message)s")
+
+    # -- diagnostics: record how this process exits ---------------------
+    import faulthandler
+    import signal as _signal
+
+    faulthandler.enable()
+
+    def _sig_logger(signum, frame):
+        logger.info("raylet received signal %s; exiting", signum)
+        try:
+            raylet.shutdown_procs()
+        except NameError:
+            pass
+        os._exit(128 + signum)
+
+    _signal.signal(_signal.SIGTERM, _sig_logger)
 
     import json
 

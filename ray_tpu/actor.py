@@ -131,6 +131,7 @@ class ActorClass:
             get_if_exists=bool(o.get("get_if_exists", False)),
             scheduling_strategy=_strategy_from_option(o.get("scheduling_strategy")),
             runtime_env=o.get("runtime_env") or {},
+            cpu_scheduling_only=o.get("num_cpus") is None,
         )
 
     def _remote(self, args, kwargs, actor_options: Dict[str, Any]) -> ActorHandle:
