@@ -70,7 +70,9 @@ class ClusterRuntime(CoreWorker):
             gcs_addr = (host, int(port_s))
             gcs = RpcClient(gcs_addr[0], gcs_addr[1])
             nodes = gcs.call_retrying("GetAllNodeInfo")
-            local = next((n for n in nodes if n["Alive"]), None)
+            alive = [n for n in nodes if n["Alive"]]
+            # prefer the head node: the driver shares its object store
+            local = next((n for n in alive if n.get("IsHead")), alive[0] if alive else None)
             if local is None:
                 raise RuntimeError("no alive nodes in cluster")
             raylet_addr = (local["NodeManagerAddress"], local["NodeManagerPort"])

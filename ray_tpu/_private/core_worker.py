@@ -65,11 +65,19 @@ logger = logging.getLogger(__name__)
 
 
 class _LeaseEntry:
-    __slots__ = ("lease_id", "worker_addr", "busy", "last_used")
+    __slots__ = ("lease_id", "worker_addr", "busy", "last_used", "raylet_addr")
 
-    def __init__(self, lease_id: str, worker_addr: Tuple[str, int]):
+    def __init__(
+        self,
+        lease_id: str,
+        worker_addr: Tuple[str, int],
+        raylet_addr: Optional[Tuple[str, int]] = None,
+    ):
         self.lease_id = lease_id
         self.worker_addr = worker_addr
+        # which raylet granted this lease (spillback may land on a remote
+        # node); ReturnWorkerLease must go back to the same raylet
+        self.raylet_addr = raylet_addr
         self.busy = False
         self.last_used = time.monotonic()
 
@@ -326,6 +334,11 @@ class CoreWorker(CoreRuntime):
         self.memory_store = MemoryStore()
         self._plasma_pins: Dict[ObjectID, memoryview] = {}
         self._pin_lock = threading.Lock()
+        # node_id -> raylet addr, for pulling remote plasma objects
+        # (owner-based location directory: the owner's memory-store entry
+        # names the node; this maps it to that node's object manager)
+        self._node_addrs: Dict[str, Tuple[str, int]] = {}
+        self._node_addrs_lock = threading.Lock()
 
         # owner RPC server (GetObject / WaitObject / health)
         self.server = RpcServer(name=f"core-{self.worker_id_hex[:8]}")
@@ -655,11 +668,94 @@ class CoreWorker(CoreRuntime):
                 pass
             self.memory_store.put(oid, ("plasma", self.node_id))
 
+    def _node_raylet_addr(self, node_id: str) -> Optional[Tuple[str, int]]:
+        with self._node_addrs_lock:
+            addr = self._node_addrs.get(node_id)
+        if addr is not None:
+            return addr
+        try:
+            infos = self.gcs.call_retrying("GetAllNodeInfo")
+        except Exception:  # noqa: BLE001
+            return None
+        with self._node_addrs_lock:
+            for n in infos:
+                self._node_addrs[n["NodeID"]] = (n["NodeManagerAddress"], n["NodeManagerPort"])
+            return self._node_addrs.get(node_id)
+
+    def _pull_remote_object(self, oid: ObjectID, node_id: str, _retry: bool = True) -> None:
+        """Fetch a plasma object from another node's store into the local
+        store, chunked (reference: object_manager.cc:221 Pull + :614
+        ReceiveObjectChunk; ours is reader-driven over the raylet RPC)."""
+        addr = self._node_raylet_addr(node_id)
+        if addr is None:
+            raise ObjectLostError(
+                f"object {oid.hex()} lives on unknown node {node_id[:12]}"
+            )
+        chunk_len = config.object_pull_chunk_bytes
+        client = get_client(addr)
+
+        def _chunk(offset: int) -> dict:
+            try:
+                rep = client.call(
+                    "PullObjectChunk", object_id_bin=oid.binary(), offset=offset,
+                    length=chunk_len, timeout=60,
+                )
+            except (RpcConnectionError, ConnectionError, OSError, TimeoutError) as e:
+                raise ObjectLostError(
+                    f"object {oid.hex()} unreachable: node {node_id[:12]} is down ({e})"
+                ) from None
+            if rep.get("status") != "ok":
+                raise ObjectLostError(
+                    f"object {oid.hex()} is gone from node {node_id[:12]}"
+                )
+            return rep
+
+        first = _chunk(0)
+        total = first["total"]
+        try:
+            buf = self.plasma.create(oid, total)
+        except FileExistsError:
+            # another thread's pull is in flight: wait for its seal WITHOUT
+            # a long blocking store get (the store client is one shared
+            # locked connection — a parked get would block the puller's
+            # seal() and deadlock until timeout)
+            deadline = time.monotonic() + config.rpc_call_timeout_s
+            while time.monotonic() < deadline:
+                state = self.plasma.contains_state(oid)
+                if state == 0:
+                    return  # sealed
+                if state == 2:
+                    break  # the other pull aborted — take over
+                time.sleep(0.005)
+            if _retry:
+                return self._pull_remote_object(oid, node_id, _retry=False)
+            raise ObjectLostError(
+                f"object {oid.hex()}: concurrent local pull never sealed"
+            )
+        ok = False
+        try:
+            data = first["data"]
+            buf.data[: len(data)] = data
+            off = len(data)
+            while off < total:
+                rep = _chunk(off)
+                d = rep["data"]
+                buf.data[off : off + len(d)] = d
+                off += len(d)
+            buf.seal()
+            ok = True
+        finally:
+            if not ok:
+                buf.abort()
+
     def _deserialize_entry(self, oid: ObjectID, entry_value: tuple) -> Any:
         kind = entry_value[0]
         if kind == "inline":
             val = deserialize(entry_value[1])
         else:  # plasma
+            node_id = entry_value[1]
+            if node_id != self.node_id and not self.plasma.contains(oid):
+                self._pull_remote_object(oid, node_id)
             [view] = self.plasma.get([oid], timeout_ms=int(config.rpc_call_timeout_s * 1000))
             if view is None:
                 raise ObjectLostError(f"object {oid.hex()} not in local store")
@@ -840,6 +936,17 @@ class CoreWorker(CoreRuntime):
                 self.plasma.delete(oid)
             except Exception:
                 pass
+            home = e.value[1]
+            if home != self.node_id:
+                # the primary copy lives on another node's store
+                addr = self._node_raylet_addr(home)
+                if addr is not None:
+                    try:
+                        get_client(addr).call_oneway(
+                            "DeleteObject", object_id_bin=oid.binary()
+                        )
+                    except Exception:
+                        pass
 
     # ==================================================================
     # Task submission (reference: normal_task_submitter.cc SubmitTask /
@@ -959,8 +1066,7 @@ class CoreWorker(CoreRuntime):
             self._lease_requests_inflight[sc] = inflight + 1
         try:
             strategy = spec.scheduling_strategy
-            reply = await self.raylet.acall(
-                "RequestWorkerLease",
+            kwargs = dict(
                 resources=spec.resources,
                 scheduling_class=sc,
                 job_id=self.job_id.hex(),
@@ -969,6 +1075,16 @@ class CoreWorker(CoreRuntime):
                 lease_timeout=config.worker_lease_timeout_ms / 1000.0,
                 timeout=config.worker_lease_timeout_ms / 1000.0 + 10.0,
             )
+            granted_by: Tuple[str, int] = self.raylet_addr
+            reply = await self.raylet.acall("RequestWorkerLease", **kwargs)
+            if reply.get("spillback"):
+                # local raylet redirected us to a node with capacity
+                # (reference: normal_task_submitter.cc:413 re-request at the
+                # spillback node); a spilled request cannot spill again
+                granted_by = tuple(reply["spillback"])
+                reply = await get_client(granted_by).acall(
+                    "RequestWorkerLease", allow_spillback=False, **kwargs
+                )
         except Exception as e:  # noqa: BLE001
             if not self._shutdown:
                 logger.warning("lease request failed: %s", e)
@@ -993,7 +1109,7 @@ class CoreWorker(CoreRuntime):
                     await asyncio.sleep(0.1)
                     await self._maybe_request_lease(sc, spec)
             return
-        entry = _LeaseEntry(reply["lease_id"], tuple(reply["worker_addr"]))
+        entry = _LeaseEntry(reply["lease_id"], tuple(reply["worker_addr"]), granted_by)
         logger.debug("lease %s granted (worker %s)", entry.lease_id[:8], entry.worker_addr)
         with self._lock:
             self._leases.setdefault(sc, []).append(entry)
@@ -1027,9 +1143,15 @@ class CoreWorker(CoreRuntime):
             if entry in entries:
                 entries.remove(entry)
         try:
-            await self.raylet.acall("ReturnWorkerLease", lease_id=entry.lease_id)
+            await self._lease_raylet(entry).acall("ReturnWorkerLease", lease_id=entry.lease_id)
         except Exception as e:  # noqa: BLE001
-            logger.warning("ReturnWorkerLease %s failed: %s", entry.lease_id[:8], e)
+            if not self._shutdown:
+                logger.warning("ReturnWorkerLease %s failed: %s", entry.lease_id[:8], e)
+
+    def _lease_raylet(self, entry: _LeaseEntry) -> RpcClient:
+        if entry.raylet_addr is None or tuple(entry.raylet_addr) == tuple(self.raylet_addr):
+            return self.raylet
+        return get_client(tuple(entry.raylet_addr))
 
     async def _push_task(self, spec: TaskSpec, entry: _LeaseEntry) -> None:
         client = get_client(entry.worker_addr)
@@ -1113,7 +1235,9 @@ class CoreWorker(CoreRuntime):
             if entry in entries:
                 entries.remove(entry)
         try:
-            await self.raylet.acall("ReturnWorkerLease", lease_id=entry.lease_id, worker_dead=True)
+            await self._lease_raylet(entry).acall(
+                "ReturnWorkerLease", lease_id=entry.lease_id, worker_dead=True
+            )
         except Exception:
             pass
         st = self._pending_tasks.get(spec.task_id)

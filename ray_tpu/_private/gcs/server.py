@@ -161,7 +161,20 @@ class GcsServer:
         if not node.alive:
             node.alive = True
             self._node_version += 1
-        return {"ok": True}
+        # piggyback the cluster resource view so raylets can spill leases
+        # to other nodes (reference: ray_syncer.h:91 resource broadcast)
+        return {"ok": True, "cluster": self._cluster_view()}
+
+    def _cluster_view(self) -> Dict[str, dict]:
+        return {
+            n.node_id: {
+                "addr": n.address,
+                "alive": n.alive,
+                "total": dict(n.total_resources),
+                "available": dict(n.available_resources),
+            }
+            for n in self.nodes.values()
+        }
 
     async def DrainNode(self, node_id: str) -> dict:
         node = self.nodes.get(node_id)

@@ -60,6 +60,126 @@ def default_node_resources(
     return out
 
 
+def spawn_gcs(port: int, session_dir: str, log_name: str = "gcs.log") -> subprocess.Popen:
+    """Spawn the GCS server process and wait until it answers Ping."""
+    env = dict(os.environ)
+    env["RAY_TPU_CONFIG_JSON"] = config.to_json()
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in [repo_root, env.get("PYTHONPATH", "")] if p
+    )
+    gcs_log = open(os.path.join(session_dir, log_name), "ab")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "ray_tpu._private.gcs.server",
+            "--port", str(port),
+            "--storage-path", config.gcs_storage_path,
+        ],
+        env=env,
+        stdout=gcs_log,
+        stderr=subprocess.STDOUT,
+    )
+    client = RpcClient("127.0.0.1", port)
+    deadline = time.monotonic() + 30
+    while True:
+        try:
+            client.call("Ping", timeout=2)
+            return proc
+        except Exception:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"GCS exited with {proc.returncode}; see {session_dir}/{log_name}"
+                )
+            if time.monotonic() > deadline:
+                raise RuntimeError("GCS did not become ready")
+            time.sleep(0.05)
+
+
+def spawn_raylet(
+    gcs_addr: Tuple[str, int],
+    node_id: str,
+    resources: Dict[str, float],
+    store_socket: str,
+    store_capacity: int,
+    session_dir: str,
+    is_head: bool = False,
+    log_name: str = "raylet.log",
+) -> Tuple[subprocess.Popen, int]:
+    """Spawn a raylet daemon process and wait for its port file.
+
+    Shared by the single-node Node bootstrap and the multi-node test
+    harness (reference: cluster_utils.Cluster add_node, cluster_utils.py:208).
+    """
+    env = dict(os.environ)
+    env["RAY_TPU_CONFIG_JSON"] = config.to_json()
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in [repo_root, env.get("PYTHONPATH", "")] if p
+    )
+    port_file = os.path.join(session_dir, "raylet_port")
+    raylet_log = open(os.path.join(session_dir, log_name), "ab")
+    cmd = [
+        sys.executable,
+        "-m",
+        "ray_tpu._private.raylet.raylet",
+        "--node-id", node_id,
+        "--gcs-addr", f"{gcs_addr[0]}:{gcs_addr[1]}",
+        "--resources-json", json.dumps(resources),
+        "--store-socket", store_socket,
+        "--store-capacity", str(store_capacity),
+        "--session-dir", session_dir,
+        "--port-file", port_file,
+        "--log-level", os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
+    ]
+    if is_head:
+        cmd.append("--is-head")
+    proc = subprocess.Popen(cmd, env=env, stdout=raylet_log, stderr=subprocess.STDOUT)
+    deadline = time.monotonic() + 30
+    while not os.path.exists(port_file):
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"raylet exited with {proc.returncode}; see {session_dir}/{log_name}"
+            )
+        if time.monotonic() > deadline:
+            raise RuntimeError("raylet failed to start in time")
+        time.sleep(0.02)
+    with open(port_file) as f:
+        port = int(f.read().strip())
+    os.remove(port_file)
+    return proc, port
+
+
+def kill_process_tree(proc: subprocess.Popen, force: bool = False) -> None:
+    """Terminate a daemon process and everything it spawned (store daemon,
+    worker processes)."""
+    if proc is None or proc.poll() is not None:
+        return
+    try:
+        parent = psutil.Process(proc.pid)
+        children = parent.children(recursive=True)
+        if force:
+            proc.kill()
+        else:
+            proc.terminate()
+        try:
+            proc.wait(timeout=3)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        for c in children:
+            try:
+                c.kill() if force else c.terminate()
+            except psutil.Error:
+                pass
+        _, alive = psutil.wait_procs(children, timeout=2)
+        for c in alive:
+            try:
+                c.kill()
+            except psutil.Error:
+                pass
+    except (psutil.Error, OSError):
+        pass
+
+
 class Node:
     """Manages head-node child processes: GCS, raylet (which owns the
     object-store daemon and workers)."""
@@ -87,70 +207,16 @@ class Node:
         return ("127.0.0.1", self.raylet_port)
 
     def start(self) -> None:
-        env = dict(os.environ)
-        env["RAY_TPU_CONFIG_JSON"] = config.to_json()
-        pythonpath = os.pathsep.join(
-            p for p in [os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), env.get("PYTHONPATH", "")] if p
+        self.gcs_proc = spawn_gcs(self.gcs_port, self.session_dir)
+        self.raylet_proc, self.raylet_port = spawn_raylet(
+            gcs_addr=self.gcs_addr,
+            node_id=self.node_id,
+            resources=self.resources,
+            store_socket=self.store_socket,
+            store_capacity=self.store_capacity,
+            session_dir=self.session_dir,
+            is_head=True,
         )
-        env["PYTHONPATH"] = pythonpath
-        gcs_log = open(os.path.join(self.session_dir, "gcs.log"), "ab")
-        self.gcs_proc = subprocess.Popen(
-            [
-                sys.executable,
-                "-m",
-                "ray_tpu._private.gcs.server",
-                "--port",
-                str(self.gcs_port),
-                "--storage-path",
-                config.gcs_storage_path,
-            ],
-            env=env,
-            stdout=gcs_log,
-            stderr=subprocess.STDOUT,
-        )
-        self._wait_rpc_ready(self.gcs_addr, "GCS")
-
-        port_file = os.path.join(self.session_dir, "raylet_port")
-        raylet_log = open(os.path.join(self.session_dir, "raylet.log"), "ab")
-        self.raylet_proc = subprocess.Popen(
-            [
-                sys.executable,
-                "-m",
-                "ray_tpu._private.raylet.raylet",
-                "--node-id",
-                self.node_id,
-                "--gcs-addr",
-                f"{self.gcs_addr[0]}:{self.gcs_addr[1]}",
-                "--resources-json",
-                json.dumps(self.resources),
-                "--store-socket",
-                self.store_socket,
-                "--store-capacity",
-                str(self.store_capacity),
-                "--is-head",
-                "--session-dir",
-                self.session_dir,
-                "--port-file",
-                port_file,
-                "--log-level",
-                os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
-            ],
-            env=env,
-            stdout=raylet_log,
-            stderr=subprocess.STDOUT,
-        )
-        deadline = time.monotonic() + 30
-        while not os.path.exists(port_file):
-            if self.raylet_proc.poll() is not None:
-                raise RuntimeError(
-                    f"raylet exited with {self.raylet_proc.returncode}; "
-                    f"see {self.session_dir}/raylet.log"
-                )
-            if time.monotonic() > deadline:
-                raise RuntimeError("raylet failed to start in time")
-            time.sleep(0.02)
-        with open(port_file) as f:
-            self.raylet_port = int(f.read().strip())
         atexit.register(self.stop)
 
     def _wait_rpc_ready(self, addr: Tuple[str, int], name: str, timeout: float = 30.0) -> None:
@@ -166,30 +232,8 @@ class Node:
                 time.sleep(0.05)
 
     def stop(self) -> None:
-        for proc in (self.raylet_proc, self.gcs_proc):
-            if proc is None or proc.poll() is not None:
-                continue
-            try:
-                # kill the whole tree (raylet owns store + workers)
-                parent = psutil.Process(proc.pid)
-                children = parent.children(recursive=True)
-                proc.terminate()
-                try:
-                    proc.wait(timeout=3)
-                except subprocess.TimeoutExpired:
-                    proc.kill()
-                for c in children:
-                    try:
-                        c.terminate()
-                    except psutil.Error:
-                        pass
-                _, alive = psutil.wait_procs(children, timeout=2)
-                for c in alive:
-                    try:
-                        c.kill()
-                    except psutil.Error:
-                        pass
-            except (psutil.Error, OSError):
-                pass
+        # kill whole trees (the raylet owns the store daemon + workers)
+        kill_process_tree(self.raylet_proc)
+        kill_process_tree(self.gcs_proc)
         self.raylet_proc = None
         self.gcs_proc = None

@@ -202,9 +202,13 @@ class StoreClient:
         self._call(MSG_RELEASE, oid.binary())
 
     def contains(self, oid: ObjectID) -> bool:
+        return self.contains_state(oid) == 0
+
+    def contains_state(self, oid: ObjectID) -> int:
+        """0 = sealed, 1 = created-but-unsealed, 2 = absent."""
         reply = self._call(MSG_CONTAINS, oid.binary())
         (status,) = struct.unpack("<i", reply)
-        return status == 0
+        return status
 
     def delete(self, oid: ObjectID) -> None:
         self._call(MSG_DELETE, oid.binary())

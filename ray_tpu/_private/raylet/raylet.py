@@ -141,6 +141,14 @@ class Raylet:
         # must never run reentrantly (two interleaved drains clobber each
         # other's rebuild); callers kick the event instead of calling it
         self._drain_wakeup: Optional[asyncio.Event] = None
+        # cluster resource view, refreshed from GCS heartbeat replies
+        # (reference: ray_syncer.h:91); drives lease spillback
+        self.cluster_view: Dict[str, dict] = {}
+        # client to this node's own store daemon, for serving object pulls
+        # (reference: object_manager.cc:587 HandlePush / :221 Pull)
+        self.store = None
+        # in-flight outbound transfers: oid -> [pinned view, last_used]
+        self._pull_pins: Dict[Any, list] = {}
 
     # ------------------------------------------------------------------
     # Worker pool (reference: worker_pool.h:280)
@@ -221,6 +229,7 @@ class Raylet:
         bundle_index: int = -1,
         lease_timeout: float = 25.0,
         release_cpu_after_grant: bool = False,
+        allow_spillback: bool = True,
     ) -> dict:
         req = {
             "resources": dict(resources),
@@ -242,7 +251,30 @@ class Raylet:
         if grant is not None:
             return grant
         rs, _ = self._resource_set_for(req)
+        # Spillback (reference: cluster_lease_manager.cc:420): the local node
+        # can't serve the request right now — redirect the caller to a node
+        # that can. Never for PG leases (bundles are node-pinned), and a
+        # spilled request can't spill again (loop prevention).
+        if allow_spillback and not pg_id:
+            if not rs.feasible(req["resources"]):
+                # can NEVER run here: any node whose totals fit will do
+                target = self._pick_spillback(req["resources"], require_available=False)
+            elif not rs.can_fit(req["resources"]):
+                # feasible but saturated: spill only to a node with capacity now
+                target = self._pick_spillback(req["resources"], require_available=True)
+            else:
+                target = None  # local can serve (worker may still be spawning)
+            if target is not None:
+                return {"granted": False, "spillback": target}
         if not rs.feasible(self._cpu_only(req["resources"], pg_id)):
+            if allow_spillback and not pg_id:
+                # the cluster view may be a heartbeat behind (a just-joined
+                # node missing): re-check for ~2 heartbeat periods before
+                # declaring the request infeasible
+                grace = max(1.0, 4 * config.raylet_heartbeat_period_ms / 1000.0)
+                target = await self._await_spillback(req["resources"], grace)
+                if target is not None:
+                    return {"granted": False, "spillback": target}
             return {
                 "granted": False,
                 "infeasible": True,
@@ -263,6 +295,45 @@ class Raylet:
 
     def _cpu_only(self, resources: Dict[str, float], pg_id: Optional[str]) -> Dict[str, float]:
         return dict(resources)
+
+    async def _await_spillback(
+        self, resources: Dict[str, float], timeout_s: float
+    ) -> Optional[Tuple[str, int]]:
+        """Poll the heartbeat-synced cluster view for a node whose totals fit
+        a locally-infeasible request (covers view staleness at startup and
+        nodes that just joined)."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            target = self._pick_spillback(resources, require_available=False)
+            if target is not None:
+                return target
+            if time.monotonic() >= deadline:
+                return None
+            await asyncio.sleep(0.1)
+
+    def _pick_spillback(
+        self, resources: Dict[str, float], require_available: bool
+    ) -> Optional[Tuple[str, int]]:
+        """Pick another node's raylet address for lease spillback, preferring
+        the most free CPU (reference: hybrid_scheduling_policy.h top-k; we
+        rank by availability over the heartbeat-synced cluster view)."""
+        best_score = None
+        best_addr = None
+        for nid, info in self.cluster_view.items():
+            if nid == self.node_id or not info.get("alive"):
+                continue
+            total = info.get("total", {})
+            avail = info.get("available", {})
+            if not all(total.get(k, 0.0) + 1e-9 >= v for k, v in resources.items()):
+                continue
+            has_now = all(avail.get(k, 0.0) + 1e-9 >= v for k, v in resources.items())
+            if require_available and not has_now:
+                continue
+            score = (1 if has_now else 0, avail.get("CPU", 0.0))
+            if best_score is None or score > best_score:
+                best_score = score
+                best_addr = tuple(info["addr"])
+        return best_addr
 
     def _resource_set_for(self, req: dict) -> Tuple[ResourceSet, Optional[Tuple[str, int]]]:
         """Returns (resource_set, committed_bundle_key). The key is the
@@ -483,6 +554,74 @@ class Raylet:
         return {"ok": True}
 
     # ------------------------------------------------------------------
+    # Object manager: serve chunked pulls from this node's store to other
+    # nodes (reference: src/ray/object_manager/object_manager.cc:221 Pull,
+    # :587 HandlePush — ours is pull-based: the reader drives the transfer)
+    # ------------------------------------------------------------------
+    async def PullObjectChunk(
+        self, object_id_bin: bytes, offset: int = 0, length: int = 0
+    ) -> dict:
+        from ray_tpu._private.ids import ObjectID
+
+        if self.store is None:
+            return {"status": "not_found"}
+        oid = ObjectID(object_id_bin)
+        loop = asyncio.get_event_loop()
+
+        def _read():
+            # pin across the whole multi-chunk transfer: a get-pin is taken
+            # on the first chunk and held in _pull_pins until the last chunk
+            # (or the idle sweeper) releases it — otherwise the store could
+            # LRU-evict the object between two chunk RPCs
+            pinned = self._pull_pins.get(oid)
+            if pinned is None:
+                [view] = self.store.get([oid], timeout_ms=100)
+                if view is None:
+                    return None
+                pinned = self._pull_pins[oid] = [view, time.monotonic()]
+            view = pinned[0]
+            pinned[1] = time.monotonic()
+            total = len(view)
+            end = min(total, offset + (length or total))
+            data = bytes(view[offset:end])
+            if end >= total:
+                self._release_pull_pin(oid)
+            return total, data
+
+        res = await loop.run_in_executor(None, _read)
+        if res is None:
+            return {"status": "not_found"}
+        total, data = res
+        return {"status": "ok", "total": total, "data": data}
+
+    def _release_pull_pin(self, oid) -> None:
+        pinned = self._pull_pins.pop(oid, None)
+        if pinned is not None:
+            try:
+                self.store.release(oid)
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def _pull_pin_sweeper_loop(self) -> None:
+        """Release transfer pins whose reader died mid-pull."""
+        while True:
+            await asyncio.sleep(10)
+            cutoff = time.monotonic() - 60
+            for oid, pinned in list(self._pull_pins.items()):
+                if pinned[1] < cutoff:
+                    self._release_pull_pin(oid)
+
+    async def DeleteObject(self, object_id_bin: bytes) -> dict:
+        from ray_tpu._private.ids import ObjectID
+
+        if self.store is not None:
+            try:
+                self.store.delete(ObjectID(object_id_bin))
+            except Exception:  # noqa: BLE001
+                pass
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
     async def GetState(self) -> dict:
         return {
             "node_id": self.node_id,
@@ -511,6 +650,9 @@ class Raylet:
                 )
                 if reply.get("reregister"):
                     await self._register()
+                view = reply.get("cluster")
+                if view:
+                    self.cluster_view = view
             except Exception as e:  # noqa: BLE001
                 logger.warning("heartbeat failed: %s", e)
             await asyncio.sleep(period)
@@ -578,9 +720,10 @@ class Raylet:
 
     async def run(self) -> None:
         # start the native object store daemon for this node
-        from ray_tpu._private.object_store.client import start_store_process
+        from ray_tpu._private.object_store.client import StoreClient, start_store_process
 
         self.store_proc = start_store_process(self.store_socket, self.store_capacity)
+        self.store = StoreClient(self.store_socket)
         self.gcs = RpcClient(self.gcs_addr[0], self.gcs_addr[1])
 
         server_task = asyncio.ensure_future(self.server.serve_forever())
@@ -592,6 +735,7 @@ class Raylet:
         asyncio.ensure_future(self._reap_loop())
         asyncio.ensure_future(self._idle_reaper_loop())
         asyncio.ensure_future(self._drain_loop())
+        asyncio.ensure_future(self._pull_pin_sweeper_loop())
         if config.worker_pool_prestart_workers:
             for _ in range(int(self.resources.total.get("CPU", 1))):
                 self._spawn_worker()

@@ -1,0 +1,154 @@
+"""In-process multi-node test cluster.
+
+Reference: python/ray/cluster_utils.py (Cluster :141, add_node :208) — starts
+one GCS plus N raylet daemons (each with its own shared-memory store and
+worker pool) as local processes, so multi-node scheduling, object transfer
+and fault-tolerance are testable on a single machine.
+
+Usage:
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2)              # head node
+    n2 = cluster.add_node(num_cpus=2, resources={"worker2": 1})
+    ray_tpu.init(address=cluster.address)
+    ...
+    cluster.remove_node(n2)                   # simulates node failure
+    cluster.shutdown()
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._private.config import config
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.node import kill_process_tree, spawn_gcs, spawn_raylet
+from ray_tpu._private.rpc import RpcClient
+
+
+@dataclass
+class ClusterNode:
+    node_id: str
+    proc: subprocess.Popen
+    raylet_port: int
+    store_socket: str
+    session_dir: str
+    resources: Dict[str, float] = field(default_factory=dict)
+    is_head: bool = False
+
+    @property
+    def raylet_addr(self) -> Tuple[str, int]:
+        return ("127.0.0.1", self.raylet_port)
+
+
+class Cluster:
+    """One GCS + N raylets on this machine, each raylet a real daemon
+    process owning its own object store and workers."""
+
+    def __init__(self):
+        self.session_dir = tempfile.mkdtemp(prefix="ray_tpu_cluster_")
+        self.gcs_proc: Optional[subprocess.Popen] = None
+        self.gcs_port: Optional[int] = None
+        self.nodes: List[ClusterNode] = []
+        self._start_gcs()
+
+    # ------------------------------------------------------------------
+    @property
+    def address(self) -> str:
+        return f"127.0.0.1:{self.gcs_port}"
+
+    @property
+    def gcs_addr(self) -> Tuple[str, int]:
+        return ("127.0.0.1", self.gcs_port)
+
+    def _start_gcs(self) -> None:
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        self.gcs_port = s.getsockname()[1]
+        s.close()
+        self.gcs_proc = spawn_gcs(self.gcs_port, self.session_dir)
+
+    # ------------------------------------------------------------------
+    def add_node(
+        self,
+        num_cpus: float = 1.0,
+        num_tpus: Optional[float] = None,
+        resources: Optional[Dict[str, float]] = None,
+        object_store_memory: Optional[int] = None,
+        labels: Optional[Dict[str, str]] = None,
+    ) -> ClusterNode:
+        node_id = NodeID.from_random().hex()
+        node_dir = os.path.join(self.session_dir, f"node-{len(self.nodes)}-{node_id[:8]}")
+        os.makedirs(node_dir, exist_ok=True)
+        store_socket = os.path.join(node_dir, "store.sock")
+        res: Dict[str, float] = dict(resources or {})
+        res["CPU"] = float(num_cpus)
+        if num_tpus:
+            res["TPU"] = float(num_tpus)
+        res.setdefault("memory", 1 * 1024**3)
+        res["node:127.0.0.1"] = 1.0
+        is_head = not self.nodes
+        proc, port = spawn_raylet(
+            gcs_addr=self.gcs_addr,
+            node_id=node_id,
+            resources=res,
+            store_socket=store_socket,
+            store_capacity=int(object_store_memory or config.object_store_memory_bytes),
+            session_dir=node_dir,
+            is_head=is_head,
+        )
+        node = ClusterNode(
+            node_id=node_id,
+            proc=proc,
+            raylet_port=port,
+            store_socket=store_socket,
+            session_dir=node_dir,
+            resources=res,
+            is_head=is_head,
+        )
+        self.nodes.append(node)
+        return node
+
+    def remove_node(self, node: ClusterNode, allow_graceful: bool = False) -> None:
+        """Kill a node's raylet (and its store + workers), simulating node
+        failure. The GCS notices via missed heartbeats."""
+        if allow_graceful:
+            try:
+                RpcClient("127.0.0.1", self.gcs_port).call(
+                    "DrainNode", node_id=node.node_id, timeout=5
+                )
+            except Exception:
+                pass
+        kill_process_tree(node.proc, force=not allow_graceful)
+        if node in self.nodes:
+            self.nodes.remove(node)
+
+    def wait_for_nodes(self, timeout: float = 30.0) -> None:
+        """Block until every added node is registered and alive in the GCS."""
+        client = RpcClient("127.0.0.1", self.gcs_port)
+        want = {n.node_id for n in self.nodes}
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                infos = client.call("GetAllNodeInfo", timeout=5)
+                alive = {n["NodeID"] for n in infos if n["Alive"]}
+                if want <= alive:
+                    return
+            except Exception:
+                pass
+            time.sleep(0.1)
+        raise TimeoutError(f"nodes did not come up: want {want}")
+
+    def shutdown(self) -> None:
+        for node in list(self.nodes):
+            kill_process_tree(node.proc)
+        self.nodes.clear()
+        kill_process_tree(self.gcs_proc)
+        self.gcs_proc = None

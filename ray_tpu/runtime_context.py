@@ -24,6 +24,10 @@ class RuntimeContext:
         n = w.current_node_id
         if n is not None:
             return n.hex() if hasattr(n, "hex") else str(n)
+        # cluster runtime: the CoreWorker knows which node it lives on
+        n = getattr(w.core, "node_id", None)
+        if n is not None:
+            return n
         nodes = w.core.nodes()
         return nodes[0]["NodeID"] if nodes else None
 

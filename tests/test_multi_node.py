@@ -1,0 +1,161 @@
+"""Multi-node cluster tests: spillback scheduling, cross-node object
+transfer, STRICT_SPREAD placement, and node-failure tolerance.
+
+Reference test model: python/ray/tests/ with cluster_utils.Cluster
+(cluster_utils.py:141) — N raylets as local processes against one GCS.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture(scope="module")
+def three_node_cluster():
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2, resources={"head": 1})
+    cluster.add_node(num_cpus=2, resources={"workerA": 1})
+    cluster.add_node(num_cpus=2, resources={"workerB": 1})
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address)
+    yield cluster
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+def test_cluster_sees_all_nodes(three_node_cluster):
+    res = ray_tpu.cluster_resources()
+    assert res["CPU"] == 6.0
+    assert res["head"] == 1.0 and res["workerA"] == 1.0 and res["workerB"] == 1.0
+    assert len([n for n in ray_tpu.nodes() if n["Alive"]]) == 3
+
+
+def test_task_spillback_to_remote_node(three_node_cluster):
+    """A task whose custom resource only exists on a remote node must spill
+    there (reference: cluster_lease_manager.cc:420 spillback)."""
+
+    @ray_tpu.remote(resources={"workerA": 0.1})
+    def where():
+        import ray_tpu.runtime_context as rc
+
+        return rc.get_runtime_context().get_node_id()
+
+    node_id = ray_tpu.get(where.remote(), timeout=60)
+    info = {n["NodeID"]: n for n in ray_tpu.nodes()}
+    assert info[node_id]["Resources"].get("workerA") == 1.0
+
+
+def test_cross_node_object_transfer(three_node_cluster):
+    """Put ~40MB on node A (task output), read it from node B and from the
+    driver — exercises the chunked pull path both ways."""
+
+    @ray_tpu.remote(resources={"workerA": 0.1})
+    def produce():
+        return np.arange(5_000_000, dtype=np.float64)  # 40 MB
+
+    @ray_tpu.remote(resources={"workerB": 0.1})
+    def consume(arr):
+        return float(arr.sum())
+
+    ref = produce.remote()
+    expected = float(np.arange(5_000_000, dtype=np.float64).sum())
+    # driver pulls from node A
+    arr = ray_tpu.get(ref, timeout=120)
+    assert float(arr.sum()) == expected
+    # node B pulls from node A (object passed by reference)
+    assert ray_tpu.get(consume.remote(ref), timeout=120) == expected
+
+
+def test_large_object_broadcast(three_node_cluster):
+    """One 100MB object read by tasks on every node (reference baseline:
+    1 GiB broadcast to 50 nodes, release/benchmarks/README.md:20)."""
+    big = np.ones(12_500_000, dtype=np.float64)  # 100 MB
+    ref = ray_tpu.put(big)
+
+    @ray_tpu.remote
+    def touch(arr):
+        return arr.nbytes
+
+    sizes = ray_tpu.get(
+        [touch.options(resources={r: 0.1}).remote(ref) for r in ("head", "workerA", "workerB")],
+        timeout=180,
+    )
+    assert sizes == [100_000_000] * 3
+
+
+def test_strict_spread_pg_across_nodes(three_node_cluster):
+    from ray_tpu.util.placement_group import (
+        placement_group,
+        placement_group_table,
+        remove_placement_group,
+    )
+
+    pg = placement_group([{"CPU": 1}] * 3, strategy="STRICT_SPREAD")
+    assert pg.wait(timeout_seconds=60)
+    info = placement_group_table(pg)
+    nodes_used = set(info["bundle_nodes"].values())
+    assert len(nodes_used) == 3, f"bundles not spread: {info['bundle_nodes']}"
+    remove_placement_group(pg)
+
+
+def test_actor_on_remote_node_and_cross_node_calls(three_node_cluster):
+    @ray_tpu.remote(resources={"workerB": 0.1})
+    class Remote:
+        def __init__(self):
+            self.data = np.arange(1_000_000, dtype=np.float32)  # lives on B
+
+        def slice_sum(self, lo, hi):
+            return float(self.data[lo:hi].sum())
+
+    a = Remote.remote()
+    assert ray_tpu.get(a.slice_sum.remote(0, 10), timeout=120) == float(
+        np.arange(10, dtype=np.float32).sum()
+    )
+
+
+def test_survive_worker_node_death():
+    """Kill a worker node: cluster marks it dead, objects it held are lost
+    with a clear error, and new work schedules on survivors."""
+    ray_tpu.shutdown()  # detach from the module fixture's cluster
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2, resources={"head": 1})
+    doomed = cluster.add_node(num_cpus=2, resources={"doomed": 1})
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address)
+    try:
+        @ray_tpu.remote(resources={"doomed": 0.1})
+        def produce():
+            return np.ones(1_000_000)  # 8 MB, lives in doomed node's store
+
+        ref = ray_tpu.get(produce.remote(), timeout=60)  # materialize
+        ref2 = produce.remote()
+        ray_tpu.wait([ref2], timeout=60)
+
+        cluster.remove_node(doomed)
+
+        # GCS notices the death
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            alive = [n for n in ray_tpu.nodes() if n["Alive"]]
+            if len(alive) == 1:
+                break
+            time.sleep(0.2)
+        assert len([n for n in ray_tpu.nodes() if n["Alive"]]) == 1
+
+        # object that lived only on the dead node is reported lost
+        with pytest.raises(ray_tpu.exceptions.ObjectLostError):
+            ray_tpu.get(ref2, timeout=30)
+
+        # the cluster still schedules new work on the surviving node
+        @ray_tpu.remote
+        def alive_task():
+            return "ok"
+
+        assert ray_tpu.get(alive_task.remote(), timeout=60) == "ok"
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
