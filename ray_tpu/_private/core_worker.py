@@ -344,6 +344,7 @@ class CoreWorker(CoreRuntime):
         self.server = RpcServer(name=f"core-{self.worker_id_hex[:8]}")
         self.server.register("GetObject", self._handle_get_object)
         self.server.register("WaitObject", self._handle_wait_object)
+        self.server.register("RecoverObject", self._handle_recover_object)
         self.server.register("AddBorrower", self._handle_add_borrower)
         self.server.register("RemoveBorrower", self._handle_remove_borrower)
         self.server.register("ActorTaskDone", self._handle_actor_task_done)
@@ -357,6 +358,15 @@ class CoreWorker(CoreRuntime):
         self._lease_requests_inflight: Dict[Any, int] = {}
         self._task_queue: Dict[Any, List[TaskSpec]] = {}
         self._pending_tasks: Dict[TaskID, Dict[str, Any]] = {}
+
+        # Lineage (reference: task_manager.h:195 lineage pinning +
+        # object_recovery_manager.h:41). For every completed normal task
+        # with in-scope plasma returns we keep the spec — arg refs stay
+        # pinned — so a lost object can be reconstructed by resubmission.
+        self._lineage_lock = threading.Lock()
+        self._lineage_tasks: Dict[TaskID, Dict[str, Any]] = {}  # tid -> {spec, live}
+        self._lineage_by_oid: Dict[ObjectID, TaskID] = {}
+        self._recovery_inflight: Dict[TaskID, threading.Event] = {}
         # actor state
         self._actor_addr_cache: Dict[str, Tuple[Tuple[str, int], int]] = {}  # id -> (addr, version)
         self._actor_dispatchers: Dict[str, _ActorDispatcher] = {}
@@ -777,7 +787,14 @@ class CoreWorker(CoreRuntime):
         while True:
             e = self.memory_store.get_if_exists(oid)
             if e is not None:
-                return self._deserialize_entry(oid, e.value)
+                try:
+                    return self._deserialize_entry(oid, e.value)
+                except ObjectLostError:
+                    # owned object whose plasma primary is gone: reconstruct
+                    # from lineage (object_recovery_manager.h:41)
+                    if self._try_recover_object(oid):
+                        continue
+                    raise
             # do we own it (pending task) or borrow it?
             owned = self._ref_counter().is_owned(oid)
             if owned:
@@ -817,7 +834,33 @@ class CoreWorker(CoreRuntime):
                     raise val
                 return val
             if reply["status"] == "plasma":
-                return self._deserialize_entry(oid, ("plasma", reply["node_id"]))
+                try:
+                    return self._deserialize_entry(oid, ("plasma", reply["node_id"]))
+                except ObjectLostError:
+                    # borrowed object lost: ask the OWNER to reconstruct it
+                    # (owners hold the lineage; this chains through nested
+                    # dependencies because each recovery re-runs the task)
+                    try:
+                        rep2 = client.call(
+                            "RecoverObject", object_id_bin=oid.binary(),
+                            timeout_s=60.0, timeout=75,
+                        )
+                    except (RpcConnectionError, ConnectionError, OSError, TimeoutError) as e3:
+                        raise ObjectLostError(
+                            f"object {oid.hex()} lost and its owner at {owner} "
+                            f"could not recover it: {e3}"
+                        ) from None
+                    st = rep2.get("status")
+                    if st == "inline":
+                        val = deserialize(rep2["data"])
+                        if isinstance(val, RayTaskError):
+                            raise val.as_instanceof_cause() from None
+                        if isinstance(val, BaseException):
+                            raise val
+                        return val
+                    if st == "plasma" and self._object_reachable(oid, rep2["node_id"]):
+                        return self._deserialize_entry(oid, ("plasma", rep2["node_id"]))
+                    raise
             if reply["status"] == "freed":
                 raise ObjectLostError(
                     f"object {oid.hex()} was already freed by its owner "
@@ -922,6 +965,7 @@ class CoreWorker(CoreRuntime):
         if inner:
             self._release_contained_refs(inner)
         self._release_unclaimed_handoffs(oid)
+        self._evict_lineage(oid)
         e = self.memory_store.get_if_exists(oid)
         self.memory_store.delete(oid)
         with self._pin_lock:
@@ -1273,6 +1317,7 @@ class CoreWorker(CoreRuntime):
                 self._absorb_dropped_handoffs({"returns": returns})
                 self.loop_thread.call_soon(self._submit_spec_threadsafe, spec)
                 return
+        plasma_returns: List[ObjectID] = []
         for i, ret in enumerate(returns):
             oid = ObjectID.from_index(spec.task_id, i + 1)
             self._record_handoff_borrows(oid, ret)
@@ -1280,8 +1325,137 @@ class CoreWorker(CoreRuntime):
                 self.memory_store.put(oid, ("inline", ret["data"]))
             else:
                 self.memory_store.put(oid, ("plasma", ret.get("node_id", self.node_id)))
-        self._release_task_refs(spec)
+                if self._ref_counter().has_reference(oid):
+                    plasma_returns.append(oid)
+        if plasma_returns:
+            # pin lineage: keep the spec (and thereby its arg-ref pins) so
+            # these shared-memory returns can be reconstructed if their
+            # node dies (task_manager.h:195); released when the last return
+            # goes out of scope (free_object)
+            with self._lineage_lock:
+                ent = self._lineage_tasks.get(spec.task_id)
+                if ent is None:
+                    self._lineage_tasks[spec.task_id] = {
+                        "spec": spec,
+                        "live": set(plasma_returns),
+                    }
+                    for oid in plasma_returns:
+                        self._lineage_by_oid[oid] = spec.task_id
+            # close the has_reference/registration race: a ref dropped in
+            # the window would have found no lineage to evict — re-check now
+            # that the entry is visible
+            for oid in plasma_returns:
+                if not self._ref_counter().has_reference(oid):
+                    self._evict_lineage(oid)
+        else:
+            self._release_task_refs(spec)
         self._pending_tasks.pop(spec.task_id, None)
+
+    # ==================================================================
+    # Object recovery (reference: object_recovery_manager.h:41 — the owner
+    # resubmits the creating task when a plasma primary is lost)
+    # ==================================================================
+    def _evict_lineage(self, oid: ObjectID) -> None:
+        """Return object went out of scope: drop it from its task's lineage;
+        release the task's arg pins when no returns remain in scope."""
+        with self._lineage_lock:
+            tid = self._lineage_by_oid.pop(oid, None)
+            if tid is None:
+                return
+            ent = self._lineage_tasks.get(tid)
+            if ent is None:
+                return
+            ent["live"].discard(oid)
+            spec = ent["spec"] if not ent["live"] else None
+            if spec is not None:
+                del self._lineage_tasks[tid]
+        if spec is not None:
+            self._release_task_refs(spec)
+
+    def _try_recover_object(self, oid: ObjectID, wait_s: float = 0.5) -> bool:
+        """Resubmit the task that created a lost object. Returns True if a
+        recovery was started (or was already in flight) — the caller should
+        re-wait on the memory store."""
+        with self._lineage_lock:
+            tid = self._lineage_by_oid.get(oid)
+            ent = self._lineage_tasks.get(tid) if tid is not None else None
+            if ent is None:
+                return False
+            ev = self._recovery_inflight.get(tid)
+            if ev is not None:
+                leader = False
+            else:
+                leader = True
+                ev = self._recovery_inflight[tid] = threading.Event()
+                spec = ent["spec"]
+                live = set(ent["live"])
+        if not leader:
+            ev.wait(timeout=30)
+            time.sleep(wait_s)  # let the resubmission register
+            return True
+        try:
+            attempts = getattr(spec, "_recovery_attempts", 0)
+            if attempts >= 3:
+                logger.error(
+                    "object %s unrecoverable: task %s already reconstructed %d times",
+                    oid.hex()[:12], spec.task_id.hex()[:12], attempts,
+                )
+                return False
+            spec._recovery_attempts = attempts + 1  # type: ignore[attr-defined]
+            logger.warning(
+                "reconstructing object %s by resubmitting task %s (attempt %d)",
+                oid.hex()[:12], spec.task_id.hex()[:12], attempts + 1,
+            )
+            # clear the stale locations so getters park on the re-creation
+            for roid in spec.return_ids():
+                if roid in live:
+                    self.memory_store.delete(roid)
+            spec.attempt_number += 1
+            self._pending_tasks[spec.task_id] = {
+                "spec": spec,
+                "retries_left": spec.max_retries,
+            }
+            self.loop_thread.call_soon(self._submit_spec_threadsafe, spec)
+            return True
+        finally:
+            ev.set()
+            with self._lineage_lock:
+                self._recovery_inflight.pop(tid, None)
+
+    def _handle_recover_object(self, object_id_bin: bytes, timeout_s: float = 60.0) -> dict:
+        """Borrower-triggered recovery: a worker holding a ref to OUR lost
+        object asks us (the owner) to reconstruct it; replies with the new
+        location once the resubmitted task lands. This is what makes chained
+        reconstruction work — each lost dependency walks back to its owner."""
+        oid = ObjectID(object_id_bin)
+        state = self._handle_get_object(object_id_bin)
+        if state["status"] == "plasma":
+            if self._object_reachable(oid, state["node_id"]):
+                return state  # healthy — the borrower's failure was transient
+            if not self._try_recover_object(oid):
+                return state
+        elif state["status"] != "pending":
+            return state
+        f = self.memory_store.as_future(oid)
+        try:
+            f.result(timeout=timeout_s)
+        except Exception:  # noqa: BLE001
+            pass
+        return self._handle_get_object(object_id_bin)
+
+    def _object_reachable(self, oid: ObjectID, node_id: str) -> bool:
+        if node_id == self.node_id:
+            return self.plasma.contains(oid)
+        addr = self._node_raylet_addr(node_id)
+        if addr is None:
+            return False
+        try:
+            rep = get_client(addr).call(
+                "ContainsObject", object_id_bin=oid.binary(), timeout=10
+            )
+            return bool(rep.get("contains"))
+        except Exception:  # noqa: BLE001
+            return False
 
     # ==================================================================
     # Actors (reference: actor_task_submitter.cc; GCS-mediated creation

@@ -24,6 +24,7 @@ import os
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 import uuid
 from dataclasses import dataclass, field
@@ -147,8 +148,10 @@ class Raylet:
         # client to this node's own store daemon, for serving object pulls
         # (reference: object_manager.cc:587 HandlePush / :221 Pull)
         self.store = None
-        # in-flight outbound transfers: oid -> [pinned view, last_used]
-        self._pull_pins: Dict[Any, list] = {}
+        # in-flight outbound transfers: oid -> {view, last_used, readers};
+        # guarded by _pull_pins_lock (touched from executor threads + loop)
+        self._pull_pins: Dict[Any, dict] = {}
+        self._pull_pins_lock = threading.Lock()
 
     # ------------------------------------------------------------------
     # Worker pool (reference: worker_pool.h:280)
@@ -268,10 +271,12 @@ class Raylet:
                 return {"granted": False, "spillback": target}
         if not rs.feasible(self._cpu_only(req["resources"], pg_id)):
             if allow_spillback and not pg_id:
-                # the cluster view may be a heartbeat behind (a just-joined
-                # node missing): re-check for ~2 heartbeat periods before
-                # declaring the request infeasible
-                grace = max(1.0, 4 * config.raylet_heartbeat_period_ms / 1000.0)
+                # The cluster view may be a heartbeat behind (a just-joined
+                # node missing). With a populated view one extra heartbeat
+                # suffices; with no view yet (raylet just started) wait
+                # longer for the first one.
+                hb = config.raylet_heartbeat_period_ms / 1000.0
+                grace = (1.5 * hb) if self.cluster_view else max(1.0, 4 * hb)
                 target = await self._await_spillback(req["resources"], grace)
                 if target is not None:
                     return {"granted": False, "spillback": target}
@@ -570,22 +575,52 @@ class Raylet:
 
         def _read():
             # pin across the whole multi-chunk transfer: a get-pin is taken
-            # on the first chunk and held in _pull_pins until the last chunk
-            # (or the idle sweeper) releases it — otherwise the store could
-            # LRU-evict the object between two chunk RPCs
-            pinned = self._pull_pins.get(oid)
+            # when the first reader starts and held in _pull_pins until the
+            # LAST concurrent reader finishes (or the idle sweeper fires) —
+            # otherwise the store could LRU-evict the object mid-transfer
+            with self._pull_pins_lock:
+                pinned = self._pull_pins.get(oid)
+                if pinned is not None:
+                    if offset == 0:
+                        pinned["readers"] += 1
+                    pinned["last_used"] = time.monotonic()
             if pinned is None:
                 [view] = self.store.get([oid], timeout_ms=100)
                 if view is None:
                     return None
-                pinned = self._pull_pins[oid] = [view, time.monotonic()]
-            view = pinned[0]
-            pinned[1] = time.monotonic()
+                extra_pin = False
+                with self._pull_pins_lock:
+                    existing = self._pull_pins.get(oid)
+                    if existing is None:
+                        pinned = self._pull_pins[oid] = {
+                            "view": view, "last_used": time.monotonic(), "readers": 1,
+                        }
+                    else:  # lost the creation race: drop our extra store pin
+                        pinned = existing
+                        if offset == 0:
+                            pinned["readers"] += 1
+                        extra_pin = True
+                if extra_pin:
+                    try:
+                        self.store.release(oid)
+                    except Exception:  # noqa: BLE001
+                        pass
+            view = pinned["view"]
             total = len(view)
             end = min(total, offset + (length or total))
             data = bytes(view[offset:end])
             if end >= total:
-                self._release_pull_pin(oid)
+                done = False
+                with self._pull_pins_lock:
+                    pinned["readers"] -= 1
+                    if pinned["readers"] <= 0 and self._pull_pins.get(oid) is pinned:
+                        del self._pull_pins[oid]
+                        done = True
+                if done:
+                    try:
+                        self.store.release(oid)
+                    except Exception:  # noqa: BLE001
+                        pass
             return total, data
 
         res = await loop.run_in_executor(None, _read)
@@ -594,22 +629,34 @@ class Raylet:
         total, data = res
         return {"status": "ok", "total": total, "data": data}
 
-    def _release_pull_pin(self, oid) -> None:
-        pinned = self._pull_pins.pop(oid, None)
-        if pinned is not None:
-            try:
-                self.store.release(oid)
-            except Exception:  # noqa: BLE001
-                pass
+    async def ContainsObject(self, object_id_bin: bytes) -> dict:
+        """Cheap liveness probe for an object in this node's store (used by
+        owners verifying a loss report before reconstructing)."""
+        from ray_tpu._private.ids import ObjectID
+
+        if self.store is None:
+            return {"contains": False}
+        oid = ObjectID(object_id_bin)
+        loop = asyncio.get_event_loop()
+        found = await loop.run_in_executor(None, lambda: self.store.contains(oid))
+        return {"contains": bool(found)}
 
     async def _pull_pin_sweeper_loop(self) -> None:
-        """Release transfer pins whose reader died mid-pull."""
+        """Release transfer pins whose readers died mid-pull."""
         while True:
             await asyncio.sleep(10)
             cutoff = time.monotonic() - 60
-            for oid, pinned in list(self._pull_pins.items()):
-                if pinned[1] < cutoff:
-                    self._release_pull_pin(oid)
+            stale = []
+            with self._pull_pins_lock:
+                for oid, pinned in list(self._pull_pins.items()):
+                    if pinned["last_used"] < cutoff:
+                        del self._pull_pins[oid]
+                        stale.append(oid)
+            for oid in stale:
+                try:
+                    self.store.release(oid)
+                except Exception:  # noqa: BLE001
+                    pass
 
     async def DeleteObject(self, object_id_bin: bytes) -> dict:
         from ray_tpu._private.ids import ObjectID
