@@ -114,9 +114,11 @@ def _run_bench(platform: str) -> None:
 
     tokens_per_step = batch * seq
     tok_s = tokens_per_step * iters / dt
-    # 6*N FLOPs/token fwd+bwd on the dense path (LoRA trains adapters but
-    # backward still traverses the base matmuls; 6N is the standard
-    # accounting and matches the reference's MFU definition).
+    # 6*N FLOPs/token fwd+bwd — honest here: this implementation computes
+    # dW for every base matmul (optax.multi_transform zeroes the *updates*
+    # of frozen params, not their gradients), so forward (2N) + backward
+    # dX (2N) + backward dW (2N) all execute on the MXU. Remat recompute
+    # and attention S² terms are NOT counted (they'd inflate MFU).
     flops_per_tok = 6 * cfg.num_params()
     mfu = tok_s * flops_per_tok / _peak_flops(dev)
 

@@ -976,21 +976,24 @@ class CoreWorker(CoreRuntime):
                 except Exception:
                     pass
         if e is not None and e.value[0] == "plasma":
-            try:
-                self.plasma.delete(oid)
-            except Exception:
-                pass
-            home = e.value[1]
-            if home != self.node_id:
-                # the primary copy lives on another node's store
-                addr = self._node_raylet_addr(home)
-                if addr is not None:
-                    try:
-                        get_client(addr).call_oneway(
-                            "DeleteObject", object_id_bin=oid.binary()
-                        )
-                    except Exception:
-                        pass
+            self._delete_plasma_copy(oid, e.value[1])
+
+    def _delete_plasma_copy(self, oid: ObjectID, home_node: str) -> None:
+        """Best-effort delete of a plasma object: local replica + the
+        primary copy on its home node."""
+        try:
+            self.plasma.delete(oid)
+        except Exception:
+            pass
+        if home_node != self.node_id:
+            addr = self._node_raylet_addr(home_node)
+            if addr is not None:
+                try:
+                    get_client(addr).call_oneway(
+                        "DeleteObject", object_id_bin=oid.binary()
+                    )
+                except Exception:
+                    pass
 
     # ==================================================================
     # Task submission (reference: normal_task_submitter.cc SubmitTask /
@@ -1321,12 +1324,20 @@ class CoreWorker(CoreRuntime):
         for i, ret in enumerate(returns):
             oid = ObjectID.from_index(spec.task_id, i + 1)
             self._record_handoff_borrows(oid, ret)
+            node = ret.get("node_id", self.node_id)
+            if not self._ref_counter().has_reference(oid):
+                # already freed (user dropped the ref mid-flight, or a
+                # recovery re-ran a task with some returns out of scope):
+                # don't resurrect the entry — and drop the plasma copy the
+                # executor just wrote, or it leaks forever
+                if ret["kind"] != "inline":
+                    self._delete_plasma_copy(oid, node)
+                continue
             if ret["kind"] == "inline":
                 self.memory_store.put(oid, ("inline", ret["data"]))
             else:
-                self.memory_store.put(oid, ("plasma", ret.get("node_id", self.node_id)))
-                if self._ref_counter().has_reference(oid):
-                    plasma_returns.append(oid)
+                self.memory_store.put(oid, ("plasma", node))
+                plasma_returns.append(oid)
         if plasma_returns:
             # pin lineage: keep the spec (and thereby its arg-ref pins) so
             # these shared-memory returns can be reconstructed if their

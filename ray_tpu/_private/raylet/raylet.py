@@ -271,12 +271,12 @@ class Raylet:
                 return {"granted": False, "spillback": target}
         if not rs.feasible(self._cpu_only(req["resources"], pg_id)):
             if allow_spillback and not pg_id:
-                # The cluster view may be a heartbeat behind (a just-joined
-                # node missing). With a populated view one extra heartbeat
-                # suffices; with no view yet (raylet just started) wait
-                # longer for the first one.
+                # The cluster view may be a couple of heartbeats behind (a
+                # just-joined node propagates via its heartbeat to GCS, then
+                # ours). Wait ~2 periods with a populated view, longer when
+                # the raylet just started and has no view at all.
                 hb = config.raylet_heartbeat_period_ms / 1000.0
-                grace = (1.5 * hb) if self.cluster_view else max(1.0, 4 * hb)
+                grace = max(1.0, 2 * hb) if self.cluster_view else max(1.0, 4 * hb)
                 target = await self._await_spillback(req["resources"], grace)
                 if target is not None:
                     return {"granted": False, "spillback": target}

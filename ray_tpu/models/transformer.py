@@ -366,13 +366,19 @@ def forward(cfg: TransformerConfig, params: Params, tokens: jax.Array,
     layer_tree = {"p": blocks}
     if lora is not None:
         layer_tree["l"] = lora
+    def _remat(fn):
+        # Full per-block remat: the backward recomputes each block from its
+        # input. Selective policies (saving attention outputs) don't help
+        # here — flash_attention's custom_vjp needs its lse residual, which
+        # only the re-run forward kernel produces.
+        return jax.checkpoint(fn) if cfg.remat else fn
+
     n_stage = mesh.shape.get("stage", 1) if mesh is not None else 1
     if n_stage > 1:
         from ray_tpu.ops.pipeline import pipelined_layers
 
         def apply_stage(layers_local, h):
-            body_fn = jax.checkpoint(body) if cfg.remat else body
-            h, _ = lax.scan(body_fn, h, layers_local)
+            h, _ = lax.scan(_remat(body), h, layers_local)
             return h
 
         x = pipelined_layers(
@@ -380,8 +386,7 @@ def forward(cfg: TransformerConfig, params: Params, tokens: jax.Array,
             num_microbatches or 2 * n_stage,
         )
     else:
-        body_fn = jax.checkpoint(body) if cfg.remat else body
-        x, _ = lax.scan(body_fn, x, layer_tree)
+        x, _ = lax.scan(_remat(body), x, layer_tree)
 
     x = _rms_norm(x, params["ln_f"], cfg.norm_eps)
     unembed = params.get("unembed")

@@ -117,8 +117,8 @@ def blockwise_attention(q, k, v, causal: bool = True,
 # cell, inner fori_loop over k blocks with online softmax in VMEM.
 # ---------------------------------------------------------------------------
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, block_k, causal,
-                      seq_k):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, block_k,
+                      causal, seq_k):
     import jax.experimental.pallas as pl
 
     block_q, d = q_ref.shape
@@ -155,11 +155,102 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, block_k, causal,
     )
     acc, m, l = lax.fori_loop(0, nk, body, init)
     o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    # logsumexp rows for the FlashAttention-2 backward: p = exp(s - lse).
+    # lse_ref holds the FULL row (all q blocks of this bh program write
+    # disjoint slices of one VMEM-resident block).
+    lse_ref[0, pl.ds(qi_base, block_q)] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, sm_scale, block_k, causal, seq_k, seq_q):
+    """dQ = scale * sum_k [P ∘ (dO V^T − Δ)] K, one q block per program,
+    inner loop over k blocks (FlashAttention-2 backward, dQ pass)."""
+    import jax.experimental.pallas as pl
+
+    block_q, d = q_ref.shape
+    qi_base = pl.program_id(1) * block_q
+    qs = q_ref[:].astype(jnp.float32) * sm_scale
+    do = do_ref[:].astype(jnp.float32)
+    lse = lse_ref[0, pl.ds(qi_base, block_q)][:, None]      # [bq,1]
+    delta = delta_ref[0, pl.ds(qi_base, block_q)][:, None]  # [bq,1]
+
+    nk = pl.cdiv(seq_k, block_k)
+    if causal:
+        nk = pl.cdiv(jnp.minimum(qi_base + block_q, seq_k), block_k)
+
+    def body(i, dq):
+        kc = k_ref[pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        vc = v_ref[pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(qs, kc.T, preferred_element_type=jnp.float32)
+        ki = i * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        qidx = qi_base + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        msk = (ki < seq_k) & (qidx < seq_q)
+        if causal:
+            msk = msk & (qidx >= ki)
+        p = jnp.where(msk, jnp.exp(s - lse), 0.0)
+        dp = jnp.dot(do, vc.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jnp.dot(ds, kc, preferred_element_type=jnp.float32)
+
+    dq = lax.fori_loop(0, nk, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[:] = (dq * sm_scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, sm_scale, block_q, causal,
+                          seq_k, seq_q):
+    """dK/dV for one k block per program, inner loop over q blocks
+    (FlashAttention-2 backward, dK/dV pass):
+    dV = Σ_q P^T dO;  dK = scale * Σ_q [P ∘ (dO V^T − Δ)]^T Q."""
+    import jax.experimental.pallas as pl
+
+    block_k, d = k_ref.shape
+    ki_base = pl.program_id(1) * block_k
+    kc = k_ref[:].astype(jnp.float32)
+    vc = v_ref[:].astype(jnp.float32)
+
+    nq_total = pl.cdiv(seq_q, block_q)
+    i0 = 0
+    if causal:
+        i0 = ki_base // block_q  # first q block intersecting the diagonal
+
+    def body(i, carry):
+        dk, dv = carry
+        qs = q_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32) * sm_scale
+        do = do_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q)][:, None]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q)][:, None]
+        s = jnp.dot(qs, kc.T, preferred_element_type=jnp.float32)
+        ki = ki_base + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        qidx = i * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        msk = (ki < seq_k) & (qidx < seq_q)
+        if causal:
+            msk = msk & (qidx >= ki)
+        p = jnp.where(msk, jnp.exp(s - lse), 0.0)
+        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, vc.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk = dk + jnp.dot(ds.T, qs, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    init = (jnp.zeros((block_k, d), jnp.float32),
+            jnp.zeros((block_k, d), jnp.float32))
+    dk, dv = lax.fori_loop(i0, nq_total, body, init)
+    # qs was pre-scaled, so dk already carries one factor of scale
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def _bhsd_to_flat(x, pad_s):
+    """[B,S,H,D] -> [B*H, S+pad, D]."""
+    b, s, h, d = x.shape
+    if pad_s:
+        x = jnp.pad(x, ((0, 0), (0, pad_s), (0, 0), (0, 0)))
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s + pad_s, d)
 
 
 def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k):
     import jax.experimental.pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
     b, sq, h, d = q.shape
     sk = k.shape[1]
@@ -168,33 +259,110 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k):
     block_k = min(block_k, sk)
     pad_q = (-sq) % block_q
     pad_k = (-sk) % block_k
-    qp = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0))) if pad_q else q
-    kp = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0), (0, 0))) if pad_k else k
-    vp = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0), (0, 0))) if pad_k else v
+    sqp, skp = sq + pad_q, sk + pad_k
 
-    # [B,S,H,D] -> [B*H, S, D] programs
-    qf = qp.transpose(0, 2, 1, 3).reshape(b * h, sq + pad_q, d)
-    kf = kp.transpose(0, 2, 1, 3).reshape(b * h, sk + pad_k, d)
-    vf = vp.transpose(0, 2, 1, 3).reshape(b * h, sk + pad_k, d)
+    qf = _bhsd_to_flat(q, pad_q)
+    kf = _bhsd_to_flat(k, pad_k)
+    vf = _bhsd_to_flat(v, pad_k)
 
-    grid = (b * h, (sq + pad_q) // block_q)
+    grid = (b * h, sqp // block_q)
     kernel = functools.partial(
         _flash_fwd_kernel, sm_scale=scale, block_k=block_k, causal=causal,
         seq_k=sk,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct(qf.shape, q.dtype),
+            jax.ShapeDtypeStruct((b * h, 1, sqp), jnp.float32),
+        ),
         grid=grid,
         in_specs=[
             pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, sk + pad_k, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, sk + pad_k, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, skp, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, skp, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, 1, sqp), lambda i, j: (i, 0, 0)),
+        ),
+    )(qf, kf, vf)
+    out = out.reshape(b, h, sqp, d).transpose(0, 2, 1, 3)
+    return out[:, :sq], lse
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale, block_q, block_k):
+    """FlashAttention-2 backward: a dQ pass and a dK/dV pass, both pallas."""
+    import jax.experimental.pallas as pl
+
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    pad_q = (-sq) % block_q
+    pad_k = (-sk) % block_k
+    sqp, skp = sq + pad_q, sk + pad_k
+
+    qf = _bhsd_to_flat(q, pad_q)
+    kf = _bhsd_to_flat(k, pad_k)
+    vf = _bhsd_to_flat(v, pad_k)
+    dof = _bhsd_to_flat(g, pad_q)
+    # Δ_i = rowsum(dO ∘ O) (the softmax-jacobian diagonal term)
+    delta = jnp.sum(
+        g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    ).transpose(0, 2, 1).reshape(b * h, 1, sq)
+    if pad_q:
+        delta = jnp.pad(delta, ((0, 0), (0, 0), (0, pad_q)))
+
+    dq_kernel = functools.partial(
+        _flash_bwd_dq_kernel, sm_scale=scale, block_k=block_k, causal=causal,
+        seq_k=sk, seq_q=sq,
+    )
+    dq = pl.pallas_call(
+        dq_kernel,
+        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        grid=(b * h, sqp // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, skp, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, skp, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, 1, sqp), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, 1, sqp), lambda i, j: (i, 0, 0)),
         ],
         out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-    )(qf, kf, vf)
-    out = out.reshape(b, h, sq + pad_q, d).transpose(0, 2, 1, 3)
-    return out[:, :sq]
+    )(qf, kf, vf, dof, lse, delta)
+
+    dkv_kernel = functools.partial(
+        _flash_bwd_dkv_kernel, sm_scale=scale, block_q=block_q, causal=causal,
+        seq_k=sk, seq_q=sq,
+    )
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct(kf.shape, k.dtype),
+            jax.ShapeDtypeStruct(vf.shape, v.dtype),
+        ),
+        grid=(b * h, skp // block_k),
+        in_specs=[
+            pl.BlockSpec((None, sqp, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, sqp, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, 1, sqp), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, 1, sqp), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+        ),
+    )(qf, kf, vf, dof, lse, delta)
+
+    def unflat(x, s_pad, s):
+        return x.reshape(b, h, s_pad, d).transpose(0, 2, 1, 3)[:, :s]
+
+    return unflat(dq, sqp, sq), unflat(dk, skp, sk), unflat(dv, skp, sk)
 
 
 def _on_tpu() -> bool:
@@ -208,22 +376,27 @@ def _on_tpu() -> bool:
 def flash_attention(q, k, v, causal: bool = True,
                     sm_scale: Optional[float] = None,
                     block_q: int = 256, block_k: int = 512):
-    """Fused attention. Pallas kernel forward on TPU; blockwise-scan
-    forward elsewhere; blockwise backward everywhere (recompute, no
-    O(S^2) residuals)."""
+    """Fused attention. Pallas kernels on TPU for BOTH passes
+    (FlashAttention-2: forward saves O + logsumexp rows; backward runs a
+    dQ pass and a dK/dV pass, no O(S^2) residuals). Blockwise-scan
+    fallback off-TPU."""
     return _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)[0]
 
 
 def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
     if _on_tpu():
-        out = _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k)
-    else:
-        out = blockwise_attention(q, k, v, causal, sm_scale, block_k)
-    return out, (q, k, v)
+        out, lse = _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k)
+        return out, (q, k, v, out, lse)
+    out = blockwise_attention(q, k, v, causal, sm_scale, block_k)
+    return out, (q, k, v, None, None)
 
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
-    q, k, v = res
+    q, k, v, o, lse = res
+    if lse is not None:
+        return _flash_bwd_pallas(
+            q, k, v, o, lse, g, causal, sm_scale, block_q, block_k
+        )
     _, vjp = jax.vjp(
         lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal, sm_scale, block_k),
         q, k, v,
