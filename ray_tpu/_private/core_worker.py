@@ -19,6 +19,7 @@ Object entry formats in the owner memory store:
 
 from __future__ import annotations
 
+import functools
 import logging
 import queue as queue_mod
 import threading
@@ -55,6 +56,7 @@ from ray_tpu.exceptions import (
     ActorUnavailableError,
     GetTimeoutError,
     ObjectLostError,
+    ObjectStoreFullError,
     RayActorError,
     RayTaskError,
     TaskCancelledError,
@@ -332,8 +334,6 @@ class CoreWorker(CoreRuntime):
         self.raylet = RpcClient(raylet_addr[0], raylet_addr[1], self.loop_thread)
         self.plasma = StoreClient(store_socket)
         self.memory_store = MemoryStore()
-        self._plasma_pins: Dict[ObjectID, memoryview] = {}
-        self._pin_lock = threading.Lock()
         # node_id -> raylet addr, for pulling remote plasma objects
         # (owner-based location directory: the owner's memory-store entry
         # names the node; this maps it to that node's object manager)
@@ -673,10 +673,34 @@ class CoreWorker(CoreRuntime):
             self.memory_store.put(oid, ("inline", data))
         else:
             try:
-                self.plasma.put_bytes(oid, data)
+                buf = self._plasma_create_backpressure(oid, len(data))
+                buf.data[:] = data
+                buf.seal()
             except FileExistsError:
                 pass
             self.memory_store.put(oid, ("plasma", self.node_id))
+
+    def _plasma_create_backpressure(self, oid: ObjectID, size: int):
+        """Create in the local store; on FULL ask the raylet to spill and
+        retry (reference: plasma/create_request_queue.h backpressure —
+        ours is client-retry over raylet-driven disk spilling)."""
+        deadline = time.monotonic() + 60.0
+        while True:
+            try:
+                return self.plasma.create(oid, size)
+            except ObjectStoreFullError:
+                freed = 0
+                try:
+                    reply = self.raylet.call(
+                        "SpillObjects", needed_bytes=size, timeout=120
+                    )
+                    freed = reply.get("freed", 0)
+                except Exception:  # noqa: BLE001
+                    pass
+                if not freed and time.monotonic() > deadline:
+                    raise
+                if not freed:
+                    time.sleep(config.object_store_full_delay_ms / 1000.0)
 
     def _node_raylet_addr(self, node_id: str) -> Optional[Tuple[str, int]]:
         with self._node_addrs_lock:
@@ -723,7 +747,7 @@ class CoreWorker(CoreRuntime):
         first = _chunk(0)
         total = first["total"]
         try:
-            buf = self.plasma.create(oid, total)
+            buf = self._plasma_create_backpressure(oid, total)
         except FileExistsError:
             # another thread's pull is in flight: wait for its seal WITHOUT
             # a long blocking store get (the store client is one shared
@@ -766,16 +790,30 @@ class CoreWorker(CoreRuntime):
             node_id = entry_value[1]
             if node_id != self.node_id and not self.plasma.contains(oid):
                 self._pull_remote_object(oid, node_id)
+            elif node_id == self.node_id and not self.plasma.contains(oid):
+                # maybe spilled to local disk — restore with backpressure:
+                # a "full" store (pinned live values) may free up as the
+                # user's arrays are collected
+                deadline = time.monotonic() + 60.0
+                while True:
+                    try:
+                        st = self.raylet.call(
+                            "RestoreObject", object_id_bin=oid.binary(), timeout=120
+                        ).get("status")
+                    except Exception:  # noqa: BLE001
+                        st = "absent"
+                    if st != "full" or time.monotonic() > deadline:
+                        break
+                    time.sleep(config.object_store_full_delay_ms / 1000.0)
             [view] = self.plasma.get([oid], timeout_ms=int(config.rpc_call_timeout_s * 1000))
             if view is None:
                 raise ObjectLostError(f"object {oid.hex()} not in local store")
-            with self._pin_lock:
-                if oid not in self._plasma_pins:
-                    self._plasma_pins[oid] = view
-                else:
-                    self.plasma.release(oid)
-                    view = self._plasma_pins[oid]
-            val = deserialize(view)
+            # the get-pin lives exactly as long as the deserialized value:
+            # released when the last zero-copy array viewing the region is
+            # collected (so long-lived refs don't wedge the store full)
+            val = deserialize(
+                view, release_cb=functools.partial(self._safe_plasma_release, oid)
+            )
         if isinstance(val, RayTaskError):
             raise val.as_instanceof_cause()
         if isinstance(val, BaseException):
@@ -968,15 +1006,21 @@ class CoreWorker(CoreRuntime):
         self._evict_lineage(oid)
         e = self.memory_store.get_if_exists(oid)
         self.memory_store.delete(oid)
-        with self._pin_lock:
-            if oid in self._plasma_pins:
-                del self._plasma_pins[oid]
-                try:
-                    self.plasma.release(oid)
-                except Exception:
-                    pass
         if e is not None and e.value[0] == "plasma":
+            # get-pins belong to live deserialized values, not the ref; the
+            # store defers the delete until outstanding pins drop
             self._delete_plasma_copy(oid, e.value[1])
+
+    def _safe_plasma_release(self, oid: ObjectID) -> None:
+        """Release a store get-pin; called from GC when the last value
+        viewing the object's memory dies (may run on any thread, possibly
+        during interpreter shutdown)."""
+        if self._shutdown:
+            return
+        try:
+            self.plasma.release(oid)
+        except Exception:  # noqa: BLE001
+            pass
 
     def _delete_plasma_copy(self, oid: ObjectID, home_node: str) -> None:
         """Best-effort delete of a plasma object: local replica + the

@@ -24,7 +24,8 @@ from typing import Dict, List, Optional, Tuple
 from ray_tpu._private.ids import ObjectID
 from ray_tpu.exceptions import ObjectStoreFullError
 
-MSG_CONNECT, MSG_CREATE, MSG_SEAL, MSG_GET, MSG_RELEASE, MSG_CONTAINS, MSG_DELETE, MSG_METRICS, MSG_ABORT = range(1, 10)
+(MSG_CONNECT, MSG_CREATE, MSG_SEAL, MSG_GET, MSG_RELEASE, MSG_CONTAINS,
+ MSG_DELETE, MSG_METRICS, MSG_ABORT, MSG_LIST) = range(1, 11)
 ST_OK, ST_FULL, ST_EXISTS, ST_NOT_FOUND, ST_NOT_SEALED, ST_TIMEOUT, ST_IN_USE = 0, -1, -2, -3, -4, -5, -6
 
 _ID_SIZE = 28
@@ -52,10 +53,16 @@ def ensure_store_built() -> str:
     return path
 
 
-def start_store_process(socket_path: str, capacity: int) -> subprocess.Popen:
+def start_store_process(
+    socket_path: str, capacity: int, no_evict: bool = False
+) -> subprocess.Popen:
     binary = ensure_store_built()
+    cmd = [binary, socket_path, str(capacity)]
+    if no_evict:
+        # FULL instead of silent LRU drop; the raylet spills to disk
+        cmd.append("no-evict")
     proc = subprocess.Popen(
-        [binary, socket_path, str(capacity)],
+        cmd,
         stdout=subprocess.DEVNULL,
         stderr=subprocess.DEVNULL,
     )
@@ -210,8 +217,26 @@ class StoreClient:
         (status,) = struct.unpack("<i", reply)
         return status
 
-    def delete(self, oid: ObjectID) -> None:
-        self._call(MSG_DELETE, oid.binary())
+    def delete(self, oid: ObjectID) -> int:
+        """Returns the store status (ST_OK, ST_NOT_FOUND, or ST_IN_USE —
+        the latter defers the delete to the last pin release)."""
+        reply = self._call(MSG_DELETE, oid.binary())
+        (status,) = struct.unpack("<i", reply)
+        return status
+
+    def list_objects(self) -> List[Tuple[bytes, int, bool, bool]]:
+        """All objects, LRU-oldest first: (id_bytes, size, sealed, pinned).
+        Feeds the raylet's spill-candidate selection."""
+        reply = self._call(MSG_LIST, b"")
+        (n,) = struct.unpack_from("<I", reply, 0)
+        out: List[Tuple[bytes, int, bool, bool]] = []
+        off = 4
+        for _ in range(n):
+            oid = bytes(reply[off : off + _ID_SIZE])
+            size, sealed, pinned = struct.unpack_from("<QBB", reply, off + _ID_SIZE)
+            off += _ID_SIZE + 10
+            out.append((oid, size, bool(sealed), bool(pinned)))
+        return out
 
     def metrics(self) -> Dict[str, int]:
         reply = self._call(MSG_METRICS, b"")

@@ -152,6 +152,22 @@ class Raylet:
         # guarded by _pull_pins_lock (touched from executor threads + loop)
         self._pull_pins: Dict[Any, dict] = {}
         self._pull_pins_lock = threading.Lock()
+        # Spilling (reference: local_object_manager.h:145 SpillObjects /
+        # :157 restore): the store runs no-evict; on pressure this raylet
+        # moves LRU sealed+unpinned objects to disk and restores on read.
+        # oid_bin -> (path, size); guarded by _spill_lock.
+        self.spill_dir = config.object_spilling_dir or os.path.join(
+            self.session_dir, "spill"
+        )
+        self.spilled: Dict[bytes, Tuple[str, int]] = {}
+        # _spill_lock guards the `spilled` dict ONLY (held briefly — async
+        # handlers touch it on the event loop); _spill_work_lock serializes
+        # whole spill/restore batches on executor threads (held across disk
+        # IO; reentrant because restore-on-full spills recursively)
+        self._spill_lock = threading.Lock()
+        self._spill_work_lock = threading.RLock()
+        self._spilled_bytes_total = 0
+        self._restored_bytes_total = 0
 
     # ------------------------------------------------------------------
     # Worker pool (reference: worker_pool.h:280)
@@ -625,21 +641,154 @@ class Raylet:
 
         res = await loop.run_in_executor(None, _read)
         if res is None:
+            # spilled objects are served straight from their file — no
+            # need to re-pressure shared memory for an outbound transfer
+            res = await loop.run_in_executor(
+                None, self._read_spilled_chunk, bytes(object_id_bin), offset, length
+            )
+        if res is None:
             return {"status": "not_found"}
         total, data = res
         return {"status": "ok", "total": total, "data": data}
 
     async def ContainsObject(self, object_id_bin: bytes) -> dict:
         """Cheap liveness probe for an object in this node's store (used by
-        owners verifying a loss report before reconstructing)."""
+        owners verifying a loss report before reconstructing). Spilled
+        objects count: they are on this node, just on disk."""
         from ray_tpu._private.ids import ObjectID
 
         if self.store is None:
             return {"contains": False}
+        with self._spill_lock:
+            if object_id_bin in self.spilled:
+                return {"contains": True}
         oid = ObjectID(object_id_bin)
         loop = asyncio.get_event_loop()
         found = await loop.run_in_executor(None, lambda: self.store.contains(oid))
         return {"contains": bool(found)}
+
+    # ------------------------------------------------------------------
+    # Spilling (reference: src/ray/raylet/local_object_manager.h:145
+    # SpillObjectsOfSize / :157 AsyncRestoreSpilledObject)
+    # ------------------------------------------------------------------
+    def _spill_path(self, oid_bin: bytes) -> str:
+        return os.path.join(self.spill_dir, oid_bin.hex())
+
+    def _spill_until(self, needed_bytes: int) -> int:
+        """Move LRU sealed+unpinned objects to disk until ~needed_bytes are
+        freed. Runs on an executor thread; batches serialize on
+        _spill_work_lock (never held on the event loop)."""
+        from ray_tpu._private.ids import ObjectID
+        from ray_tpu._private.object_store.client import ST_OK
+
+        freed = 0
+        with self._spill_work_lock:
+            try:
+                candidates = self.store.list_objects()
+            except Exception:  # noqa: BLE001
+                return 0
+            with self._pull_pins_lock:
+                transferring = set(self._pull_pins)
+            for oid_bin, size, sealed, pinned in candidates:
+                if freed >= needed_bytes:
+                    break
+                if not sealed or pinned:
+                    continue
+                oid = ObjectID(oid_bin)
+                if oid in transferring:
+                    continue
+                [view] = self.store.get([oid], timeout_ms=0)
+                if view is None:
+                    continue
+                path = self._spill_path(oid_bin)
+                try:
+                    with open(path, "wb") as f:
+                        f.write(view)
+                finally:
+                    self.store.release(oid)
+                status = self.store.delete(oid)
+                with self._spill_lock:
+                    self.spilled[oid_bin] = (path, size)
+                self._spilled_bytes_total += size
+                if status == ST_OK:
+                    # a pinned-between-list-and-delete object has
+                    # pending_delete set and frees memory on last release;
+                    # don't count bytes that aren't actually free yet
+                    freed += size
+            if freed:
+                logger.info("spilled %d bytes to %s", freed, self.spill_dir)
+        return freed
+
+    async def SpillObjects(self, needed_bytes: int) -> dict:
+        """Create backpressure: a client whose create got FULL asks us to
+        make room (reference: plasma/create_request_queue.h — ours is
+        client-driven retry over raylet-driven spill)."""
+        loop = asyncio.get_event_loop()
+        freed = await loop.run_in_executor(None, self._spill_until, int(needed_bytes))
+        return {"freed": freed}
+
+    def _restore_sync(self, oid_bin: bytes) -> str:
+        """Bring a spilled object back into shared memory. Returns
+        "ok" | "absent" | "full"."""
+        from ray_tpu._private.ids import ObjectID
+
+        oid = ObjectID(oid_bin)
+        with self._spill_work_lock:
+            with self._spill_lock:
+                ent = self.spilled.get(oid_bin)
+            if ent is None:
+                return "absent"
+            path, size = ent
+            for attempt in range(2):
+                try:
+                    with open(path, "rb") as f:
+                        data = f.read()
+                except FileNotFoundError:
+                    return "absent"
+                try:
+                    buf = self.store.create(oid, len(data))
+                except FileExistsError:
+                    break  # concurrent restore won
+                except Exception:  # noqa: BLE001 — FULL: spill others, retry
+                    if attempt == 0:
+                        self._spill_until(len(data))
+                        continue
+                    return "full"
+                buf.data[:] = data
+                buf.seal()
+                break
+            with self._spill_lock:
+                still = self.spilled.pop(oid_bin, None)
+            if still is None:
+                # the owner freed the object mid-restore: don't resurrect
+                # an orphan in a store that never evicts
+                self.store.delete(oid)
+                return "absent"
+            self._restored_bytes_total += size
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return "ok"
+
+    async def RestoreObject(self, object_id_bin: bytes) -> dict:
+        loop = asyncio.get_event_loop()
+        status = await loop.run_in_executor(None, self._restore_sync, bytes(object_id_bin))
+        return {"status": status}
+
+    def _read_spilled_chunk(self, oid_bin: bytes, offset: int, length: int):
+        with self._spill_lock:
+            ent = self.spilled.get(oid_bin)
+        if ent is None:
+            return None
+        path, size = ent
+        try:
+            with open(path, "rb") as f:
+                f.seek(offset)
+                data = f.read(length or size)
+        except OSError:
+            return None
+        return size, data
 
     async def _pull_pin_sweeper_loop(self) -> None:
         """Release transfer pins whose readers died mid-pull."""
@@ -666,10 +815,19 @@ class Raylet:
                 self.store.delete(ObjectID(object_id_bin))
             except Exception:  # noqa: BLE001
                 pass
+        with self._spill_lock:
+            ent = self.spilled.pop(bytes(object_id_bin), None)
+        if ent is not None:
+            try:
+                os.unlink(ent[0])
+            except OSError:
+                pass
         return {"ok": True}
 
     # ------------------------------------------------------------------
     async def GetState(self) -> dict:
+        with self._spill_lock:
+            n_spilled = len(self.spilled)
         return {
             "node_id": self.node_id,
             "total": self.resources.total,
@@ -679,6 +837,9 @@ class Raylet:
             "num_leases": len(self.leases),
             "pending_leases": len(self.pending),
             "bundles": list(self.committed_bundles.keys()),
+            "spilled_objects": n_spilled,
+            "spilled_bytes_total": self._spilled_bytes_total,
+            "restored_bytes_total": self._restored_bytes_total,
         }
 
     async def Ping(self) -> str:
@@ -766,10 +927,14 @@ class Raylet:
         )
 
     async def run(self) -> None:
-        # start the native object store daemon for this node
+        # start the native object store daemon for this node (no-evict:
+        # the spill path below preserves data instead of LRU-dropping it)
         from ray_tpu._private.object_store.client import StoreClient, start_store_process
 
-        self.store_proc = start_store_process(self.store_socket, self.store_capacity)
+        os.makedirs(self.spill_dir, exist_ok=True)
+        self.store_proc = start_store_process(
+            self.store_socket, self.store_capacity, no_evict=True
+        )
         self.store = StoreClient(self.store_socket)
         self.gcs = RpcClient(self.gcs_addr[0], self.gcs_addr[1])
 

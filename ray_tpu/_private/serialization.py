@@ -121,21 +121,77 @@ def serialize_into(value: Any, alloc: Callable[[int], memoryview]) -> memoryview
     return mv
 
 
-def deserialize(data: "bytes | memoryview") -> Any:
-    mv = memoryview(data)
-    (meta_len,) = struct.unpack_from("<I", mv, 0)
-    off = 4
-    meta = mv[off : off + meta_len]
-    off += meta_len
-    (nbuf,) = struct.unpack_from("<I", mv, off)
-    off += 4
-    buffers = []
-    for _ in range(nbuf):
-        (blen,) = struct.unpack_from("<Q", mv, off)
-        off += 8
-        buffers.append(mv[off : off + blen])  # zero-copy view
-        off += blen
-    return pickle.loads(bytes(meta) if isinstance(meta, memoryview) else meta, buffers=buffers)
+class _TrackedBuffer:
+    """Buffer-protocol wrapper (PEP 688) around a shared-memory slice.
+
+    Zero-copy deserialized arrays keep their exporter alive through the
+    buffer protocol; when the LAST tracked buffer of a deserialize() call
+    is garbage-collected, the shared release callback fires — that is how
+    a store get-pin lives exactly as long as the values viewing it
+    (reference: plasma client buffer lifetime, plasma/client.h:261)."""
+
+    __slots__ = ("_mv", "_shared")
+
+    def __init__(self, mv: memoryview, shared: list):
+        self._mv = mv
+        self._shared = shared
+        with shared[2]:
+            shared[0] += 1
+
+    def __buffer__(self, flags):
+        return memoryview(self._mv)
+
+    def __del__(self):
+        s = self._shared
+        cb = None
+        with s[2]:  # __del__ may run concurrently on different threads
+            s[0] -= 1
+            if s[0] == 0 and s[1] is not None:
+                cb, s[1] = s[1], None
+        if cb is not None:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — GC context
+                pass
+
+
+def deserialize(data: "bytes | memoryview", release_cb: Optional[Callable] = None) -> Any:
+    """Deserialize the wire format. With ``release_cb``, out-of-band buffers
+    are zero-copy views and the callback fires once every reconstructed
+    value viewing them has been collected (pin-for-value-lifetime)."""
+    shared = [0, release_cb, threading.Lock()]
+    try:
+        mv = memoryview(data)
+        (meta_len,) = struct.unpack_from("<I", mv, 0)
+        off = 4
+        meta = mv[off : off + meta_len]
+        off += meta_len
+        (nbuf,) = struct.unpack_from("<I", mv, off)
+        off += 4
+        buffers = []
+        for _ in range(nbuf):
+            (blen,) = struct.unpack_from("<Q", mv, off)
+            off += 8
+            sl = mv[off : off + blen]  # zero-copy view
+            buffers.append(_TrackedBuffer(sl, shared) if release_cb else sl)
+            off += blen
+        return pickle.loads(
+            bytes(meta) if isinstance(meta, memoryview) else meta, buffers=buffers
+        )
+    finally:
+        # no tracked buffer exists (none created, or creation failed):
+        # nothing views the region, release now. Otherwise the buffers'
+        # GC fires the shared callback.
+        if release_cb is not None:
+            fire = None
+            with shared[2]:
+                if shared[0] == 0 and shared[1] is not None:
+                    fire, shared[1] = shared[1], None
+            if fire is not None:
+                try:
+                    fire()
+                except Exception:  # noqa: BLE001
+                    pass
 
 
 def dumps_function(fn: Any) -> bytes:

@@ -203,7 +203,9 @@ def _execute_callable(
             else:
                 oid = ObjectID.from_index(task_id, i + 1)
                 try:
-                    w.core.plasma.put_bytes(oid, data)
+                    buf = w.core._plasma_create_backpressure(oid, len(data))
+                    buf.data[:] = data
+                    buf.seal()
                 except FileExistsError:
                     pass
                 returns.append(

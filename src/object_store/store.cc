@@ -22,9 +22,18 @@
 //   METRICS  (8): {} -> {u64 capacity, u64 allocated, u64 num_objects,
 //                        u64 num_evictions, u64 bytes_evicted}
 //   ABORT    (9): {id[28]} -> {i32 status}   (abort unsealed create)
+//   LIST    (10): {} -> {u32 n, n*{id[28], u64 size, u8 sealed, u8 pinned}}
+//                 (LRU order, oldest first — spill candidates first;
+//                  serves the raylet's spill-on-pressure policy)
 //
 // status codes: 0 OK, -1 FULL, -2 EXISTS, -3 NOT_FOUND, -4 NOT_SEALED,
 //               -5 TIMEOUT, -6 IN_USE.
+//
+// argv: store <socket> <capacity> [no-evict]. With no-evict the store
+// returns FULL instead of silently dropping LRU objects — the raylet then
+// spills to disk (reference: local_object_manager.h:145) so no data is
+// ever lost; without it the original LRU eviction applies (replica
+// caches).
 
 #include <arpa/inet.h>
 #include <errno.h>
@@ -56,7 +65,7 @@ namespace {
 constexpr size_t kIdSize = 28;
 constexpr uint8_t MSG_CONNECT = 1, MSG_CREATE = 2, MSG_SEAL = 3, MSG_GET = 4,
                   MSG_RELEASE = 5, MSG_CONTAINS = 6, MSG_DELETE = 7,
-                  MSG_METRICS = 8, MSG_ABORT = 9;
+                  MSG_METRICS = 8, MSG_ABORT = 9, MSG_LIST = 10;
 constexpr int32_t ST_OK = 0, ST_FULL = -1, ST_EXISTS = -2, ST_NOT_FOUND = -3,
                   ST_NOT_SEALED = -4, ST_TIMEOUT = -5, ST_IN_USE = -6;
 
@@ -163,6 +172,9 @@ struct Entry {
   int creator_fd = -1;
   std::list<ObjectId>::iterator lru_it;
   bool in_lru = false;
+  // DELETE arrived while pinned: drop the object when the last pin is
+  // released (plasma semantics — buffers outlive the delete request)
+  bool pending_delete = false;
 };
 
 struct PendingGet {
@@ -183,12 +195,13 @@ struct Client {
 
 class Store {
  public:
-  Store(size_t capacity, int pool_fd, uint8_t* base)
-      : alloc_(capacity), pool_fd_(pool_fd), base_(base) {}
+  Store(size_t capacity, int pool_fd, uint8_t* base, bool no_evict)
+      : alloc_(capacity), pool_fd_(pool_fd), base_(base), no_evict_(no_evict) {}
 
   PoolAllocator alloc_;
   int pool_fd_;
   uint8_t* base_;
+  bool no_evict_;
   std::unordered_map<ObjectId, Entry, IdHash> objects_;
   std::list<ObjectId> lru_;  // front = most recent
   std::deque<std::shared_ptr<PendingGet>> waiting_gets_;
@@ -210,6 +223,7 @@ class Store {
         alloc_.Free(off);  // probe only
         return true;
       }
+      if (no_evict_) return false;  // caller spills via the raylet instead
       // find eviction victim from LRU tail
       bool evicted = false;
       for (auto it = lru_.rbegin(); it != lru_.rend(); ++it) {
@@ -268,8 +282,8 @@ int send_fd(int sock, const void* data, size_t len, int fd) {
 // ---------------------------------------------------------------------------
 class Server {
  public:
-  Server(const std::string& sock_path, size_t capacity)
-      : sock_path_(sock_path), capacity_(capacity) {}
+  Server(const std::string& sock_path, size_t capacity, bool no_evict)
+      : sock_path_(sock_path), capacity_(capacity), no_evict_(no_evict) {}
 
   int Run() {
     // shm pool
@@ -288,7 +302,7 @@ class Server {
       perror("mmap");
       return 1;
     }
-    store_ = std::make_unique<Store>(capacity_, pool_fd, base);
+    store_ = std::make_unique<Store>(capacity_, pool_fd, base, no_evict_);
 
     // listening socket
     unlink(sock_path_.c_str());
@@ -375,7 +389,13 @@ class Server {
     // release this client's pins; abort its unsealed creates
     for (auto& [id, cnt] : c.pins) {
       auto oit = store_->objects_.find(id);
-      if (oit != store_->objects_.end()) oit->second.refcount -= cnt;
+      if (oit == store_->objects_.end()) continue;
+      oit->second.refcount -= cnt;
+      if (oit->second.refcount <= 0 && oit->second.pending_delete) {
+        store_->alloc_.Free(oit->second.offset);
+        if (oit->second.in_lru) store_->lru_.erase(oit->second.lru_it);
+        store_->objects_.erase(oit);
+      }
     }
     std::vector<ObjectId> to_abort;
     for (auto& [id, e] : store_->objects_) {
@@ -533,6 +553,11 @@ class Server {
           if (it->second.refcount > 0) it->second.refcount--;
           auto pit = c.pins.find(id);
           if (pit != c.pins.end() && --pit->second <= 0) c.pins.erase(pit);
+          if (it->second.refcount == 0 && it->second.pending_delete) {
+            store_->alloc_.Free(it->second.offset);
+            if (it->second.in_lru) store_->lru_.erase(it->second.lru_it);
+            store_->objects_.erase(it);
+          }
           put_i32(payload, ST_OK);
         }
         frame_reply(c, MSG_RELEASE, payload);
@@ -558,6 +583,7 @@ class Server {
         if (it == store_->objects_.end()) {
           put_i32(payload, ST_NOT_FOUND);
         } else if (it->second.refcount > 0) {
+          it->second.pending_delete = true;  // applied on last release
           put_i32(payload, ST_IN_USE);
         } else {
           store_->alloc_.Free(it->second.offset);
@@ -582,6 +608,25 @@ class Server {
           put_i32(payload, ST_OK);
         }
         frame_reply(c, MSG_ABORT, payload);
+        break;
+      }
+      case MSG_LIST: {
+        // LRU tail first (oldest → best spill candidates)
+        std::string body;
+        uint32_t listed = 0;
+        for (auto it = store_->lru_.rbegin(); it != store_->lru_.rend(); ++it) {
+          auto oit = store_->objects_.find(*it);
+          if (oit == store_->objects_.end()) continue;
+          body.append(it->b, kIdSize);
+          put_u64(body, oit->second.size);
+          put_u8(body, oit->second.state == ObjState::SEALED ? 1 : 0);
+          put_u8(body, oit->second.refcount > 0 ? 1 : 0);
+          listed++;
+        }
+        std::string payload;
+        put_u32(payload, listed);
+        payload.append(body);
+        frame_reply(c, MSG_LIST, payload);
         break;
       }
       case MSG_METRICS: {
@@ -693,6 +738,7 @@ class Server {
 
   std::string sock_path_;
   size_t capacity_;
+  bool no_evict_ = false;
   int listen_fd_ = -1;
   int epfd_ = -1;
   std::unique_ptr<Store> store_;
@@ -703,10 +749,12 @@ class Server {
 
 int main(int argc, char** argv) {
   if (argc < 3) {
-    fprintf(stderr, "usage: %s <socket_path> <capacity_bytes>\n", argv[0]);
+    fprintf(stderr, "usage: %s <socket_path> <capacity_bytes> [no-evict]\n",
+            argv[0]);
     return 2;
   }
   signal(SIGPIPE, SIG_IGN);
-  Server server(argv[1], strtoull(argv[2], nullptr, 10));
+  bool no_evict = argc > 3 && strcmp(argv[3], "no-evict") == 0;
+  Server server(argv[1], strtoull(argv[2], nullptr, 10), no_evict);
   return server.Run();
 }

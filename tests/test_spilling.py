@@ -1,0 +1,49 @@
+"""Object spilling + create backpressure: workloads larger than the store
+complete, with transparent restore on read (reference:
+local_object_manager.h:145 spill / :157 restore, create_request_queue.h).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def small_store_cluster():
+    # 64 MB store; each object below is 8 MB
+    ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_put_twice_store_capacity_and_read_back(small_store_cluster):
+    n_obj, n_elem = 16, 1_000_000  # 16 x 8 MB = 128 MB = 2x capacity
+    refs = []
+    for i in range(n_obj):
+        refs.append(ray_tpu.put(np.full(n_elem, float(i))))
+    # everything readable back (early objects restored from disk)
+    for i, r in enumerate(refs):
+        arr = ray_tpu.get(r, timeout=120)
+        assert arr[0] == float(i) and arr.shape == (n_elem,)
+    # spill actually happened
+    from ray_tpu._private import worker as worker_mod
+
+    state = worker_mod.global_worker.core.raylet.call("GetState", timeout=10)
+    assert state["spilled_bytes_total"] > 0
+
+
+def test_task_outputs_spill_and_serve(small_store_cluster):
+    @ray_tpu.remote
+    def produce(i):
+        return np.full(1_000_000, float(i))  # 8 MB each
+
+    @ray_tpu.remote
+    def total(arr):
+        return float(arr[0])
+
+    refs = [produce.remote(i) for i in range(16)]  # 2x capacity
+    ray_tpu.wait(refs, num_returns=len(refs), timeout=180)
+    # consume them all through tasks (worker-side restore path)
+    vals = ray_tpu.get([total.remote(r) for r in refs], timeout=180)
+    assert vals == [float(i) for i in range(16)]
