@@ -27,6 +27,7 @@ from ray_tpu._private.ids import (
     WorkerID,
 )
 from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.streaming import ObjectRefGenerator
 from ray_tpu._private.worker import (
     available_resources,
     cancel,
@@ -121,6 +122,7 @@ __all__ = [
     "get_runtime_context",
     "method",
     "ObjectRef",
+    "ObjectRefGenerator",
     "ActorClass",
     "ActorHandle",
     "RemoteFunction",

@@ -3,6 +3,28 @@
 from __future__ import annotations
 
 import asyncio
+import inspect
+
+ASYNC_ACTOR_DEFAULT_CONCURRENCY = 100
+
+
+def has_async_methods(obj) -> bool:
+    """True if a class/instance defines any ``async def`` method — the
+    actor then runs an event loop and EVERY method executes on it (sync
+    ones included, serialized), matching the reference's async actors.
+    Shared by the cluster worker and local mode so they can't drift."""
+    for m in dir(obj):
+        if m.startswith("__"):
+            continue
+        fn = getattr(obj, m, None)
+        if inspect.iscoroutinefunction(fn):
+            return True
+        if inspect.isasyncgenfunction(fn):
+            raise TypeError(
+                f"async generator method {m!r} is not supported yet; use a "
+                "sync generator (streams) or an async method returning a list"
+            )
+    return False
 
 
 def as_asyncio_future(ref) -> "asyncio.Future":

@@ -300,7 +300,11 @@ class _ActorDispatcher:
             return  # connection-level failures are the poll's job
         status = reply.get("status")
         if status == "done":
-            self.core._handle_actor_task_done(tid.binary(), reply["returns"])
+            self.core._handle_actor_task_done(
+                tid.binary(), reply["returns"],
+                streaming_done=reply.get("streaming_done"),
+                stream_error=reply.get("stream_error"),
+            )
         elif status == "unknown":
             self.core._fail_actor_task(
                 tid, info["return_oids"],
@@ -348,6 +352,8 @@ class CoreWorker(CoreRuntime):
         self.server.register("AddBorrower", self._handle_add_borrower)
         self.server.register("RemoveBorrower", self._handle_remove_borrower)
         self.server.register("ActorTaskDone", self._handle_actor_task_done)
+        self.server.register("StreamingYield", self._handle_streaming_yield)
+        self.server.register("StreamingDone", self._handle_streaming_done)
         self.server.register("Ping", lambda: "pong")
         self.server.start(self.loop_thread)
         self.address: Tuple[str, int] = (self.server.host, self.server.port)
@@ -358,6 +364,9 @@ class CoreWorker(CoreRuntime):
         self._lease_requests_inflight: Dict[Any, int] = {}
         self._task_queue: Dict[Any, List[TaskSpec]] = {}
         self._pending_tasks: Dict[TaskID, Dict[str, Any]] = {}
+
+        # streaming generators: task_id -> _StreamState (task_manager.cc:778)
+        self._streams: Dict[TaskID, Any] = {}
 
         # Lineage (reference: task_manager.h:195 lineage pinning +
         # object_recovery_manager.h:41). For every completed normal task
@@ -646,7 +655,12 @@ class CoreWorker(CoreRuntime):
     # Objects
     # ==================================================================
     def _ref_counter(self):
-        return worker_mod.global_worker.reference_counter
+        w = worker_mod.global_worker
+        if w is None:  # interpreter/driver shutdown race: no-op counter
+            from ray_tpu._private.reference_counter import ReferenceCounter
+
+            return ReferenceCounter()
+        return w.reference_counter
 
     def put(self, value: Any) -> ObjectRef:
         w = worker_mod.global_worker
@@ -672,23 +686,27 @@ class CoreWorker(CoreRuntime):
         if len(data) <= config.object_store_inline_max_bytes:
             self.memory_store.put(oid, ("inline", data))
         else:
-            try:
-                buf = self._plasma_create_backpressure(oid, len(data))
-                buf.data[:] = data
-                buf.seal()
-            except FileExistsError:
-                pass
+            self._plasma_put_with_backpressure(oid, data)
             self.memory_store.put(oid, ("plasma", self.node_id))
 
     def _plasma_create_backpressure(self, oid: ObjectID, size: int):
         """Create in the local store; on FULL ask the raylet to spill and
         retry (reference: plasma/create_request_queue.h backpressure —
         ours is client-retry over raylet-driven disk spilling)."""
+        if size > self.plasma.pool_size:
+            raise ObjectStoreFullError(
+                f"object of {size} bytes exceeds store capacity "
+                f"{self.plasma.pool_size}"
+            )
         deadline = time.monotonic() + 60.0
         while True:
             try:
                 return self.plasma.create(oid, size)
             except ObjectStoreFullError:
+                # hard bound even while spills keep freeing (concurrent
+                # producers can otherwise livelock this loop)
+                if time.monotonic() > deadline:
+                    raise
                 freed = 0
                 try:
                     reply = self.raylet.call(
@@ -697,10 +715,18 @@ class CoreWorker(CoreRuntime):
                     freed = reply.get("freed", 0)
                 except Exception:  # noqa: BLE001
                     pass
-                if not freed and time.monotonic() > deadline:
-                    raise
                 if not freed:
                     time.sleep(config.object_store_full_delay_ms / 1000.0)
+
+    def _plasma_put_with_backpressure(self, oid: ObjectID, data: bytes) -> None:
+        """Write a serialized object into the local store, spilling on
+        pressure; no-op if the object already exists."""
+        try:
+            buf = self._plasma_create_backpressure(oid, len(data))
+            buf.data[:] = data
+            buf.seal()
+        except FileExistsError:
+            pass
 
     def _node_raylet_addr(self, node_id: str) -> Optional[Tuple[str, int]]:
         with self._node_addrs_lock:
@@ -1097,9 +1123,10 @@ class CoreWorker(CoreRuntime):
                 self._ref_counter().remove_submitted_task_ref(a.object_id)
         self._release_contained_refs(getattr(spec, "contained_refs", []))
 
-    def submit_task(self, remote_function, args, kwargs, opts: TaskOptions) -> List[ObjectRef]:
+    def submit_task(self, remote_function, args, kwargs, opts: TaskOptions):
         w = worker_mod.global_worker
         task_id = TaskID.for_normal_task(self.job_id)
+        streaming = opts.num_returns == "streaming"
         ser_args, ser_kwargs, contained = self._serialize_args(args, kwargs)
         from ray_tpu._private.serialization import dumps_function
 
@@ -1109,22 +1136,27 @@ class CoreWorker(CoreRuntime):
             task_type=TaskType.NORMAL_TASK,
             function_descriptor=remote_function._descriptor,
             args=ser_args,
-            num_returns=opts.num_returns,
+            num_returns=0 if streaming else opts.num_returns,
             resources=opts.resources,
             scheduling_strategy=opts.scheduling_strategy,
-            max_retries=opts.max_retries,
+            # a partially-consumed stream cannot be transparently replayed
+            max_retries=0 if streaming else opts.max_retries,
             retry_exceptions=opts.retry_exceptions,
             caller_addr=self.address,
             serialized_function=dumps_function(remote_function._function),
             runtime_env=opts.runtime_env,
         )
+        spec.is_streaming_generator = streaming
         spec.kwargs_map = ser_kwargs  # type: ignore[attr-defined]
         spec.contained_refs = contained  # type: ignore[attr-defined]
         return_ids = spec.return_ids()
         for oid in return_ids:
             self._ref_counter().add_owned_object(oid, pending_creation=True)
-        self._pending_tasks[task_id] = {"spec": spec, "retries_left": opts.max_retries}
+        self._pending_tasks[task_id] = {"spec": spec, "retries_left": spec.max_retries}
+        gen = self._register_stream(task_id) if streaming else None
         self.loop_thread.call_soon(self._submit_spec_threadsafe, spec)
+        if streaming:
+            return gen
         return [ObjectRef(oid, owner_addr=self.address) for oid in return_ids]
 
     def _submit_spec_threadsafe(self, spec: TaskSpec) -> None:
@@ -1211,6 +1243,8 @@ class CoreWorker(CoreRuntime):
             specs = self._task_queue.pop(sc, [])
         data = serialize(err if isinstance(err, RayTaskError) else RayTaskError("task", str(err)))
         for s in specs:
+            if s.is_streaming_generator:
+                self._fail_stream(s.task_id, err)
             for oid in s.return_ids():
                 self.memory_store.put(oid, ("inline", data))
             self._release_task_refs(s)
@@ -1289,6 +1323,7 @@ class CoreWorker(CoreRuntime):
     def _pack_spec(self, spec: TaskSpec) -> dict:
         return {
             "py_paths": self._driver_py_paths(),
+            "streaming": spec.is_streaming_generator,
             "task_id": spec.task_id.binary(),
             "job_id": spec.job_id.binary(),
             "task_type": spec.task_type.value,
@@ -1343,6 +1378,8 @@ class CoreWorker(CoreRuntime):
                 f"Worker died while running the task: {error}",
                 WorkerCrashedError(str(error)),
             )
+            if spec.is_streaming_generator:
+                self._fail_stream(spec.task_id, err.as_instanceof_cause())
             data = serialize(err)
             for oid in spec.return_ids():
                 self.memory_store.put(oid, ("inline", data))
@@ -1350,6 +1387,17 @@ class CoreWorker(CoreRuntime):
             self._pending_tasks.pop(spec.task_id, None)
 
     def _complete_task(self, spec: TaskSpec, reply: dict) -> None:
+        if spec.is_streaming_generator:
+            # yields were delivered out-of-band; finalize idempotently in
+            # case the worker's StreamingDone push was lost
+            self._handle_streaming_done(
+                spec.task_id.binary(),
+                count=reply.get("streaming_done", 0),
+                error=reply.get("stream_error"),
+            )
+            self._release_task_refs(spec)
+            self._pending_tasks.pop(spec.task_id, None)
+            return
         returns = reply.get("returns", [])
         retriable_error = reply.get("retriable_error")
         if reply.get("dropped_borrows"):
@@ -1594,11 +1642,13 @@ class CoreWorker(CoreRuntime):
                 )
         raise ActorUnavailableError(f"Actor {actor_id_hex[:12]} not schedulable in time")
 
-    def submit_actor_task(self, handle, method_name, args, kwargs, opts: TaskOptions) -> List[ObjectRef]:
+    def submit_actor_task(self, handle, method_name, args, kwargs, opts: TaskOptions):
         actor_id: ActorID = handle._actor_id
         aid = actor_id.hex()
         task_id = TaskID.for_actor_task(actor_id)
-        return_ids = [ObjectID.from_index(task_id, i + 1) for i in range(opts.num_returns)]
+        streaming = opts.num_returns == "streaming"
+        n_returns = 0 if streaming else opts.num_returns
+        return_ids = [ObjectID.from_index(task_id, i + 1) for i in range(n_returns)]
         for oid in return_ids:
             self._ref_counter().add_owned_object(oid, pending_creation=True)
         ser_args, ser_kwargs, contained = self._serialize_args(args, kwargs)
@@ -1616,7 +1666,8 @@ class CoreWorker(CoreRuntime):
             "task_id": task_id.binary(),
             "method_name": method_name,
             "caller_id": self.worker_id_hex,
-            "num_returns": opts.num_returns,
+            "num_returns": n_returns,
+            "streaming": streaming,
             "args": [
                 {
                     "is_ref": a.is_ref,
@@ -1637,7 +1688,10 @@ class CoreWorker(CoreRuntime):
             },
             "caller_addr": self.address,
         }
+        gen = self._register_stream(task_id) if streaming else None
         self._get_dispatcher(aid).submit(payload, return_ids)
+        if streaming:
+            return gen
         return [ObjectRef(oid, owner_addr=self.address) for oid in return_ids]
 
     def _get_dispatcher(self, aid: str) -> _ActorDispatcher:
@@ -1649,12 +1703,17 @@ class CoreWorker(CoreRuntime):
             return disp
 
     def _handle_actor_task_done(
-        self, task_id_bin: bytes, returns: List[dict], dropped_borrows: list = None
+        self, task_id_bin: bytes, returns: List[dict], dropped_borrows: list = None,
+        streaming_done: Optional[int] = None, stream_error: Optional[bytes] = None,
     ) -> dict:
         """Execution result pushed back by the actor's worker."""
         tid = TaskID(task_id_bin)
         if dropped_borrows:
             self._absorb_dropped_handoffs({"dropped_borrows": dropped_borrows})
+        if streaming_done is not None:
+            # reliable finalizer for actor streaming methods (the direct
+            # StreamingDone push may have been lost); idempotent
+            self._handle_streaming_done(task_id_bin, streaming_done, stream_error)
         with self._actor_pending_lock:
             info = self._pending_actor_tasks.pop(tid, None)
             contained = self._actor_task_contained.pop(tid, [])
@@ -1674,11 +1733,85 @@ class CoreWorker(CoreRuntime):
                 self.memory_store.put(oid, ("plasma", ret.get("node_id", self.node_id)))
         return {"ok": True}
 
+    # ==================================================================
+    # Streaming generators — caller side (reference: task_manager.cc:778)
+    # ==================================================================
+    def _register_stream(self, task_id: TaskID):
+        from ray_tpu._private.streaming import ObjectRefGenerator, _StreamState
+
+        st = _StreamState()
+        self._streams[task_id] = st
+        return ObjectRefGenerator(self, task_id, st)
+
+    def _handle_streaming_yield(
+        self, task_id_bin: bytes, index: int, kind: str,
+        data: Optional[bytes] = None, node_id: Optional[str] = None,
+    ) -> dict:
+        tid = TaskID(task_id_bin)
+        st = self._streams.get(tid)
+        if st is None:
+            return {"ok": False}  # stream abandoned — drop
+        oid = ObjectID.from_index(tid, index + 1)
+        rc = self._ref_counter()
+        if not rc.has_reference(oid):
+            rc.add_owned_object(oid)
+        if kind == "inline":
+            self.memory_store.put(oid, ("inline", data))
+        else:
+            self.memory_store.put(oid, ("plasma", node_id))
+        with st.cv:
+            st.arrived[index] = oid
+            st.cv.notify_all()
+        return {"ok": True}
+
+    def _handle_streaming_done(
+        self, task_id_bin: bytes, count: int, error: Optional[bytes] = None
+    ) -> dict:
+        tid = TaskID(task_id_bin)
+        st = self._streams.get(tid)
+        if st is None:
+            return {"ok": False}
+        with st.cv:
+            if error is not None:
+                err = deserialize(error)
+                st.error = err.as_instanceof_cause() if isinstance(err, RayTaskError) else err
+            st.total = count
+            st.cv.notify_all()
+        return {"ok": True}
+
+    def _abandon_stream(self, task_id: TaskID) -> None:
+        """Consumer dropped its ObjectRefGenerator: free undelivered yields
+        and refuse further pushes (the producer stops on the first refusal)."""
+        st = self._streams.pop(task_id, None)
+        if st is None:
+            return
+        with st.cv:
+            oids = list(st.arrived.values())
+            st.arrived.clear()
+            if st.total is None:
+                st.total = st.next_index
+            st.cv.notify_all()
+        for oid in oids:
+            try:
+                self.free_object(oid)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _fail_stream(self, task_id: TaskID, err: Exception) -> None:
+        st = self._streams.get(task_id)
+        if st is None:
+            return
+        with st.cv:
+            if st.error is None and st.total is None:
+                st.error = err
+            st.cv.notify_all()
+
     def _fail_actor_task(self, tid: TaskID, return_oids: List[ObjectID], err: Exception) -> None:
         with self._actor_pending_lock:
             self._pending_actor_tasks.pop(tid, None)
             contained = self._actor_task_contained.pop(tid, [])
         self._release_contained_refs(contained)
+        self._fail_stream(tid, err)
         data = serialize(err)
         for oid in return_oids:
             if not self.memory_store.contains(oid):

@@ -9,6 +9,7 @@ don't need process isolation.
 
 from __future__ import annotations
 
+import inspect
 import threading
 import traceback
 from concurrent.futures import Future, ThreadPoolExecutor
@@ -32,9 +33,20 @@ class _LocalActor:
         self.actor_id = actor_id
         self.opts = opts
         self.dead = False
-        self.executor = ThreadPoolExecutor(
-            max_workers=max(1, opts.max_concurrency), thread_name_prefix=f"actor-{actor_id.hex()[:8]}"
+        from ray_tpu._private.async_compat import (
+            ASYNC_ACTOR_DEFAULT_CONCURRENCY,
+            has_async_methods,
         )
+
+        self.is_async = has_async_methods(cls)
+        n_workers = max(1, opts.max_concurrency)
+        if self.is_async and n_workers == 1:
+            n_workers = ASYNC_ACTOR_DEFAULT_CONCURRENCY
+        self.executor = ThreadPoolExecutor(
+            max_workers=n_workers, thread_name_prefix=f"actor-{actor_id.hex()[:8]}"
+        )
+        self._loop = None
+        self._loop_lock = threading.Lock()
         self.instance = None
         self.init_error: Optional[BaseException] = None
         self._init_done = threading.Event()
@@ -48,6 +60,28 @@ class _LocalActor:
                 self._init_done.set()
 
         self.executor.submit(_init)
+
+    def run_call(self, method, args, kwargs):
+        """Asyncio actor: EVERY method runs on the actor's event loop —
+        coroutines overlap, sync methods serialize on the loop thread
+        (single-threaded actor state stays safe)."""
+        import asyncio
+
+        with self._loop_lock:
+            if self._loop is None:
+                self._loop = asyncio.new_event_loop()
+                threading.Thread(
+                    target=self._loop.run_forever, daemon=True,
+                    name=f"actor-loop-{self.actor_id.hex()[:8]}",
+                ).start()
+
+        async def _invoke():
+            if inspect.iscoroutinefunction(method):
+                return await method(*args, **kwargs)
+            return method(*args, **kwargs)
+
+        fut = asyncio.run_coroutine_threadsafe(_invoke(), self._loop)
+        return fut.result()
 
     def wait_ready(self, timeout=None) -> None:
         self._init_done.wait(timeout)
@@ -65,6 +99,8 @@ class LocalModeRuntime(CoreRuntime):
         self._named_actors: Dict[Tuple[str, str], ActorID] = {}
         self._cancelled: set = set()
         self._task_for_ref: Dict[ObjectID, TaskID] = {}
+        self._streams: Dict[TaskID, Any] = {}  # streaming generator states
+        self.address = None  # local refs need no owner address
         self._lock = threading.Lock()
         self._resources: Dict[str, float] = {"CPU": float(num_cpus)}
         if resources:
@@ -132,6 +168,19 @@ class LocalModeRuntime(CoreRuntime):
         self.store.delete(oid)
         self._task_for_ref.pop(oid, None)
 
+    def _abandon_stream(self, task_id: TaskID) -> None:
+        st = self._streams.pop(task_id, None)
+        if st is None:
+            return
+        with st.cv:
+            oids = list(st.arrived.values())
+            st.arrived.clear()
+            if st.total is None:
+                st.total = st.next_index
+            st.cv.notify_all()
+        for oid in oids:
+            self.free_object(oid)
+
     # ------------------------------------------------------------------
     def _resolve_args(self, args, kwargs):
         def _res(v):
@@ -161,9 +210,11 @@ class LocalModeRuntime(CoreRuntime):
         for oid, v in zip(return_ids, vals):
             self.store.put(oid, v)
 
-    def submit_task(self, remote_function, args, kwargs, opts: TaskOptions) -> List[ObjectRef]:
+    def submit_task(self, remote_function, args, kwargs, opts: TaskOptions):
         w = worker_mod.global_worker
         task_id = TaskID.for_normal_task(self.job_id)
+        if opts.num_returns == "streaming":
+            return self._submit_streaming(remote_function, args, kwargs, task_id)
         return_ids = [ObjectID.from_index(task_id, i + 1) for i in range(opts.num_returns)]
         for oid in return_ids:
             w.reference_counter.add_owned_object(oid, pending_creation=True)
@@ -191,6 +242,53 @@ class LocalModeRuntime(CoreRuntime):
             self._task_for_ref[oid] = task_id
         return refs
 
+    def _submit_streaming(self, remote_function, args, kwargs, task_id: TaskID):
+        """Streaming generator task, in-process (same ObjectRefGenerator as
+        the cluster runtime; yields land in the local store)."""
+
+        def produce():
+            rargs, rkwargs = self._resolve_args(args, kwargs)
+            return remote_function._function(*rargs, **rkwargs)
+
+        return self._run_stream(self._pool, task_id, remote_function._name, produce)
+
+    def _run_stream(self, executor, task_id: TaskID, name: str, produce):
+        """Shared streaming driver: run ``produce()`` (an iterator factory)
+        on ``executor``, landing yields in the local store as they appear."""
+        from ray_tpu._private.streaming import ObjectRefGenerator, _StreamState
+
+        w = worker_mod.global_worker
+        st = _StreamState()
+        self._streams[task_id] = st
+
+        def _run():
+            idx = 0
+            try:
+                for value in produce():
+                    oid = ObjectID.from_index(task_id, idx + 1)
+                    w.reference_counter.add_owned_object(oid)
+                    self.store.put(oid, value)
+                    with st.cv:
+                        if st.total is not None:
+                            break  # abandoned
+                        st.arrived[idx] = oid
+                        st.cv.notify_all()
+                    idx += 1
+                with st.cv:
+                    if st.total is None:
+                        st.total = idx
+                    st.cv.notify_all()
+            except BaseException as e:  # noqa: BLE001
+                tb = traceback.format_exc()
+                err = RayTaskError(name, tb, e if isinstance(e, Exception) else None)
+                with st.cv:
+                    st.error = err.as_instanceof_cause()
+                    st.total = idx
+                    st.cv.notify_all()
+
+        executor.submit(_run)
+        return ObjectRefGenerator(self, task_id, st)
+
     # ------------------------------------------------------------------
     def create_actor(self, actor_class, args, kwargs, opts: ActorOptions):
         name_key = None
@@ -213,15 +311,22 @@ class LocalModeRuntime(CoreRuntime):
     def submit_actor_task(self, handle, method_name, args, kwargs, opts: TaskOptions):
         actor = self._actors.get(handle._actor_id)
         task_id = TaskID.for_actor_task(handle._actor_id)
-        return_ids = [ObjectID.from_index(task_id, i + 1) for i in range(opts.num_returns)]
+        streaming = opts.num_returns == "streaming"
+        n_returns = 0 if streaming else opts.num_returns
+        return_ids = [ObjectID.from_index(task_id, i + 1) for i in range(n_returns)]
         w = worker_mod.global_worker
         for oid in return_ids:
             w.reference_counter.add_owned_object(oid, pending_creation=True)
         if actor is None or actor.dead:
             err = ActorDiedError()
+            if streaming:
+                raise err
             for oid in return_ids:
                 self.store.put(oid, err, is_exception=True)
             return [ObjectRef(oid) for oid in return_ids]
+
+        if streaming:
+            return self._submit_actor_streaming(actor, method_name, args, kwargs, task_id)
 
         def _run():
             try:
@@ -234,7 +339,10 @@ class LocalModeRuntime(CoreRuntime):
             try:
                 rargs, rkwargs = self._resolve_args(args, kwargs)
                 method = getattr(actor.instance, method_name)
-                result = method(*rargs, **rkwargs)
+                if actor.is_async:
+                    result = actor.run_call(method, rargs, rkwargs)
+                else:
+                    result = method(*rargs, **rkwargs)
                 self._store_returns(return_ids, result, opts.num_returns)
             except BaseException as e:  # noqa: BLE001
                 tb = traceback.format_exc()
@@ -244,6 +352,14 @@ class LocalModeRuntime(CoreRuntime):
 
         actor.executor.submit(_run)
         return [ObjectRef(oid) for oid in return_ids]
+
+    def _submit_actor_streaming(self, actor, method_name, args, kwargs, task_id: TaskID):
+        def produce():
+            actor.wait_ready()
+            rargs, rkwargs = self._resolve_args(args, kwargs)
+            return getattr(actor.instance, method_name)(*rargs, **rkwargs)
+
+        return self._run_stream(actor.executor, task_id, method_name, produce)
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
         with self._lock:

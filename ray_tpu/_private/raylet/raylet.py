@@ -168,6 +168,10 @@ class Raylet:
         self._spill_work_lock = threading.RLock()
         self._spilled_bytes_total = 0
         self._restored_bytes_total = 0
+        # freshly restored objects get a short no-respill grace so the
+        # reader that asked for the restore can pin them before the next
+        # spill round picks them (they are sealed+unpinned+LRU-old)
+        self._restore_grace: Dict[bytes, float] = {}
 
     # ------------------------------------------------------------------
     # Worker pool (reference: worker_pool.h:280)
@@ -689,11 +693,17 @@ class Raylet:
                 return 0
             with self._pull_pins_lock:
                 transferring = set(self._pull_pins)
+            now = time.monotonic()
+            self._restore_grace = {
+                k: t for k, t in self._restore_grace.items() if now - t < 10.0
+            }
             for oid_bin, size, sealed, pinned in candidates:
                 if freed >= needed_bytes:
                     break
                 if not sealed or pinned:
                     continue
+                if oid_bin in self._restore_grace:
+                    continue  # just restored for a reader; let it pin first
                 oid = ObjectID(oid_bin)
                 if oid in transferring:
                     continue
@@ -765,6 +775,7 @@ class Raylet:
                 self.store.delete(oid)
                 return "absent"
             self._restored_bytes_total += size
+            self._restore_grace[oid_bin] = time.monotonic()
             try:
                 os.unlink(path)
             except OSError:

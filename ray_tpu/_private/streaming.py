@@ -1,0 +1,100 @@
+"""Streaming generators: consume a task's yields while it still runs.
+
+Reference: streaming-generator returns in src/ray/core_worker/
+task_manager.cc:778 (HandleReportGeneratorItemReturns) and
+python/ray/_raylet.pyx ObjectRefGenerator — re-designed for the pickle-RPC
+runtime: the executing worker pushes one ``StreamingYield`` RPC per yielded
+value to the caller (inline payload or a plasma location), then
+``StreamingDone``; the caller-side ``ObjectRefGenerator`` hands out
+ObjectRefs in yield order as they arrive.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, TYPE_CHECKING
+
+from ray_tpu._private.ids import ObjectID, TaskID
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu.exceptions import GetTimeoutError
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ray_tpu._private.core_worker import CoreWorker
+
+
+class _StreamState:
+    """Caller-side bookkeeping for one streaming task."""
+
+    def __init__(self) -> None:
+        self.cv = threading.Condition()
+        self.arrived: Dict[int, ObjectID] = {}  # yield index -> oid
+        self.next_index = 0  # next index to hand to the consumer
+        self.total: Optional[int] = None  # set by StreamingDone
+        self.error: Optional[BaseException] = None
+
+
+class ObjectRefGenerator:
+    """Iterator over a streaming task's yields (reference:
+    python/ray/_raylet.pyx ObjectRefGenerator). Each ``__next__`` returns
+    an ObjectRef as soon as that yield has been produced — the task may
+    still be running."""
+
+    def __init__(self, core: "CoreWorker", task_id: TaskID, state: _StreamState):
+        self._core = core
+        self._task_id = task_id
+        self._state = state
+
+    def __iter__(self) -> "ObjectRefGenerator":
+        return self
+
+    def __next__(self) -> ObjectRef:
+        return self._next(timeout=None)
+
+    def next_ref(self, timeout: Optional[float] = None) -> ObjectRef:
+        """Like ``next()`` but with a timeout (raises GetTimeoutError)."""
+        return self._next(timeout=timeout)
+
+    def _next(self, timeout: Optional[float]) -> ObjectRef:
+        st = self._state
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with st.cv:
+            while True:
+                if st.next_index in st.arrived:
+                    oid = st.arrived.pop(st.next_index)
+                    st.next_index += 1
+                    return ObjectRef(oid, owner_addr=self._core.address)
+                if st.error is not None:
+                    self._core._streams.pop(self._task_id, None)
+                    raise st.error
+                if st.total is not None and st.next_index >= st.total:
+                    self._core._streams.pop(self._task_id, None)
+                    raise StopIteration
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise GetTimeoutError(
+                        f"no yield from streaming task {self._task_id.hex()[:12]} in time"
+                    )
+                st.cv.wait(timeout=remaining if remaining is not None else 1.0)
+
+    def completed(self) -> bool:
+        st = self._state
+        with st.cv:
+            return st.error is not None or (
+                st.total is not None and st.next_index >= st.total
+            )
+
+    def __del__(self):
+        # dropping the generator abandons the stream: undelivered yields
+        # are freed and the producer's next push is refused (the worker
+        # then stops producing) — without this a dropped generator pins
+        # every yield for the life of the driver
+        try:
+            abandon = getattr(self._core, "_abandon_stream", None)
+            if abandon is not None:
+                abandon(self._task_id)
+        except Exception:  # noqa: BLE001 — GC context
+            pass
+
+    def __repr__(self) -> str:
+        return f"ObjectRefGenerator(task={self._task_id.hex()[:12]})"

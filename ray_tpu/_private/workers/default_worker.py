@@ -15,6 +15,7 @@ The worker:
 
 from __future__ import annotations
 
+import inspect
 import logging
 import os
 import threading
@@ -60,14 +61,58 @@ class _ActorRunner:
     def __init__(self, actor_id: str, instance: Any, max_concurrency: int):
         self.actor_id = actor_id
         self.instance = instance
+        # asyncio actors: any `async def` method gives the actor its own
+        # event loop; calls overlap without seqno ordering (reference:
+        # concurrency_group_manager.cc + fiber.h async actors, whose
+        # default max concurrency is high)
+        from ray_tpu._private.async_compat import (
+            ASYNC_ACTOR_DEFAULT_CONCURRENCY,
+            has_async_methods,
+        )
+
+        self.is_async = has_async_methods(instance)
+        if self.is_async and max_concurrency <= 1:
+            max_concurrency = ASYNC_ACTOR_DEFAULT_CONCURRENCY
         self.max_concurrency = max(1, max_concurrency)
         self.pool = ThreadPoolExecutor(max_workers=self.max_concurrency, thread_name_prefix=f"actor-{actor_id[:8]}")
+        self._loop: Optional[Any] = None
+        if self.is_async:
+            import asyncio
+
+            self._loop = asyncio.new_event_loop()
+            t = threading.Thread(
+                target=self._loop.run_forever, daemon=True,
+                name=f"actor-loop-{actor_id[:8]}",
+            )
+            t.start()
         self.dead = False
         self.lock = threading.Lock()
         self.inflight: set = set()  # task_id bins accepted but not finished
         # completed results kept until delivery is confirmed (or LRU-evicted)
         # so the caller's QueryActorTaskResult can recover a lost push
         self.results: "OrderedDict[bytes, list]" = OrderedDict()
+
+    def _call_method(self, method_name: str):
+        """Build the invoke callable. For asyncio actors EVERY method runs
+        on the actor's event loop — coroutines await there (overlapping),
+        sync methods execute serialized on the loop thread, preserving the
+        actor's single-threaded state guarantee (reference: async actors
+        run everything on the loop). Plain actors call on the pool thread."""
+        method = getattr(self.instance, method_name)
+        if not self.is_async:
+            return lambda args, kwargs: method(*args, **kwargs)
+        import asyncio
+
+        async def _invoke(args, kwargs):
+            if inspect.iscoroutinefunction(method):
+                return await method(*args, **kwargs)
+            return method(*args, **kwargs)
+
+        def call(args, kwargs):
+            fut = asyncio.run_coroutine_threadsafe(_invoke(args, kwargs), self._loop)
+            return fut.result()
+
+        return call
 
     def submit(self, payload: dict) -> None:
         with self.lock:
@@ -77,26 +122,43 @@ class _ActorRunner:
     def query(self, task_id_bin: bytes) -> dict:
         with self.lock:
             if task_id_bin in self.results:
-                return {"status": "done", "returns": self.results.pop(task_id_bin)}
+                result = self.results.pop(task_id_bin)
+                return {
+                    "status": "done",
+                    "returns": result["returns"],
+                    "streaming_done": result.get("streaming_done"),
+                    "stream_error": result.get("stream_error"),
+                }
             if task_id_bin in self.inflight:
                 return {"status": "running"}
         return {"status": "unknown"}
 
     def _run(self, payload: dict) -> None:
-        result = _execute_callable(
-            lambda args, kwargs: getattr(self.instance, payload["method_name"])(*args, **kwargs),
-            payload["args"],
-            payload["kwargs"],
-            payload["num_returns"],
-            TaskID(payload["task_id"]),
-            payload["method_name"],
-            actor_id=ActorID.from_hex(payload["actor_id"]),
-            caller_addr=tuple(payload["caller_addr"]),
-        )
+        if payload.get("streaming"):
+            result = _execute_streaming(
+                getattr(self.instance, payload["method_name"]),
+                payload["args"],
+                payload["kwargs"],
+                TaskID(payload["task_id"]),
+                payload["method_name"],
+                tuple(payload["caller_addr"]),
+                actor_id=ActorID.from_hex(payload["actor_id"]),
+            )
+        else:
+            result = _execute_callable(
+                self._call_method(payload["method_name"]),
+                payload["args"],
+                payload["kwargs"],
+                payload["num_returns"],
+                TaskID(payload["task_id"]),
+                payload["method_name"],
+                actor_id=ActorID.from_hex(payload["actor_id"]),
+                caller_addr=tuple(payload["caller_addr"]),
+            )
         task_bin = payload["task_id"]
         with self.lock:
             self.inflight.discard(task_bin)
-            self.results[task_bin] = result["returns"]
+            self.results[task_bin] = result
             while len(self.results) > self._RESULT_CACHE_MAX:
                 self.results.popitem(last=False)
         caller_addr = tuple(payload["caller_addr"])
@@ -108,6 +170,10 @@ class _ActorRunner:
                     task_id_bin=task_bin,
                     returns=result["returns"],
                     dropped_borrows=result.get("dropped_borrows") or [],
+                    # streaming methods: the done RPC is the reliable
+                    # finalizer in case the StreamingDone push was lost
+                    streaming_done=result.get("streaming_done"),
+                    stream_error=result.get("stream_error"),
                     timeout=30,
                 )
                 with self.lock:
@@ -202,12 +268,7 @@ def _execute_callable(
                 returns.append({"kind": "inline", "data": data, "borrows": borrows})
             else:
                 oid = ObjectID.from_index(task_id, i + 1)
-                try:
-                    buf = w.core._plasma_create_backpressure(oid, len(data))
-                    buf.data[:] = data
-                    buf.seal()
-                except FileExistsError:
-                    pass
+                w.core._plasma_put_with_backpressure(oid, data)
                 returns.append(
                     {"kind": "plasma", "node_id": w.core.node_id, "borrows": borrows}
                 )
@@ -226,6 +287,62 @@ def _execute_callable(
         }
     finally:
         w.set_task_context(None, None)
+
+
+def _execute_streaming(
+    fn,
+    packed_args: List[dict],
+    packed_kwargs: Dict[str, dict],
+    task_id: TaskID,
+    name: str,
+    caller_addr: Tuple[str, int],
+    actor_id: Optional[ActorID] = None,
+) -> dict:
+    """Run a generator task, pushing one StreamingYield per value to the
+    caller as it is produced (reference: task_manager.cc:778 generator
+    item returns). The per-yield ack is the backpressure: the generator
+    does not advance until the caller has registered the previous item."""
+    w = worker_mod.global_worker
+    w.set_task_context(task_id, actor_id)
+    client = get_client(tuple(caller_addr))
+    idx = 0
+    try:
+        args, kwargs = _resolve_args(packed_args, packed_kwargs)
+        for value in fn(*args, **kwargs):
+            data = serialize(value)
+            if len(data) <= config.object_store_inline_max_bytes:
+                rep = client.call(
+                    "StreamingYield", task_id_bin=task_id.binary(), index=idx,
+                    kind="inline", data=data, timeout=60,
+                )
+            else:
+                oid = ObjectID.from_index(task_id, idx + 1)
+                w.core._plasma_put_with_backpressure(oid, data)
+                rep = client.call(
+                    "StreamingYield", task_id_bin=task_id.binary(), index=idx,
+                    kind="plasma", node_id=w.core.node_id, timeout=60,
+                )
+            if not (rep or {}).get("ok", True):
+                break  # consumer abandoned the stream — stop producing
+            idx += 1
+        done = {"count": idx, "error": None}
+    except BaseException as e:  # noqa: BLE001
+        tb = traceback.format_exc()
+        err = RayTaskError(name, tb, e if isinstance(e, Exception) else None)
+        done = {"count": idx, "error": serialize(err)}
+    finally:
+        w.set_task_context(None, None)
+    try:
+        client.call(
+            "StreamingDone", task_id_bin=task_id.binary(),
+            count=done["count"], error=done["error"], timeout=60,
+        )
+    except Exception:  # noqa: BLE001 — the reply carries the same info
+        pass
+    reply = {"returns": [], "streaming_done": done["count"]}
+    if done["error"] is not None:
+        reply["stream_error"] = done["error"]
+    return reply
 
 
 class WorkerServer:
@@ -283,6 +400,9 @@ class WorkerServer:
                         f"{type(e).__name__}: {e}\n{traceback.format_exc()}",
                     )
                 )
+                if spec_payload.get("streaming"):
+                    # streams have no return slots: surface via stream error
+                    return {"returns": [], "streaming_done": 0, "stream_error": err}
                 return {
                     "returns": [
                         {"kind": "inline", "data": err}
@@ -291,6 +411,17 @@ class WorkerServer:
                 }
             self._function_cache[fn_bytes] = fn
         caller_addr = spec_payload.get("caller_addr")
+        if spec_payload.get("streaming"):
+            fut = self._task_pool.submit(
+                _execute_streaming,
+                fn,
+                spec_payload["args"],
+                spec_payload["kwargs"],
+                TaskID(spec_payload["task_id"]),
+                spec_payload["function_name"],
+                tuple(caller_addr),
+            )
+            return fut.result()
         fut = self._task_pool.submit(
             _execute_callable,
             lambda args, kwargs: fn(*args, **kwargs),

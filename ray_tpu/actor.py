@@ -7,6 +7,7 @@ ActorMethod :848, ActorHandle :2252, _actor_method_call :2456).
 from __future__ import annotations
 
 import functools
+import inspect
 from typing import Any, Dict, Optional
 
 from ray_tpu._private import worker as worker_mod
@@ -70,13 +71,15 @@ class ActorHandle:
         opts = self._method_opts.get(item, {})
         return ActorMethod(self, item, num_returns=opts.get("num_returns", 1))
 
-    def _actor_method_call(self, method_name: str, args, kwargs, num_returns: int = 1):
+    def _actor_method_call(self, method_name: str, args, kwargs, num_returns=1):
         w = worker_mod._require_connected()
         opts = TaskOptions(num_returns=num_returns)
-        refs = w.core.submit_actor_task(self, method_name, args, kwargs, opts)
+        out = w.core.submit_actor_task(self, method_name, args, kwargs, opts)
+        if num_returns == "streaming":
+            return out  # ObjectRefGenerator
         if num_returns == 1:
-            return refs[0]
-        return refs
+            return out[0]
+        return out
 
     def __reduce__(self):
         return (
@@ -146,7 +149,10 @@ class ActorClass:
             fn = getattr(self._cls, m, None)
             if callable(fn):
                 methods.append(m)
-                mo = getattr(fn, "__ray_tpu_method_opts__", None)
+                mo = dict(getattr(fn, "__ray_tpu_method_opts__", None) or {})
+                if inspect.isgeneratorfunction(fn):
+                    # generator methods stream their yields
+                    mo.setdefault("num_returns", "streaming")
                 if mo:
                     method_opts[m] = mo
         return ActorHandle(actor_id, methods, self._name, method_opts)

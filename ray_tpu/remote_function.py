@@ -71,8 +71,15 @@ class RemoteFunction:
         max_retries = o.get("max_retries")
         if max_retries is None:
             max_retries = config.task_max_retries_default
+        num_returns = o.get("num_returns")
+        if num_returns is None:
+            # generator functions stream their yields by default
+            # (reference: generators return ObjectRefGenerator)
+            num_returns = (
+                "streaming" if inspect.isgeneratorfunction(self._function) else 1
+            )
         return TaskOptions(
-            num_returns=o.get("num_returns", 1),
+            num_returns=num_returns,
             resources=resources,
             max_retries=max_retries,
             retry_exceptions=bool(o.get("retry_exceptions", False)),
@@ -84,10 +91,12 @@ class RemoteFunction:
     def _remote(self, args, kwargs, task_options: Dict[str, Any]):
         w = worker_mod._require_connected()
         opts = self._build_opts(task_options)
-        refs = w.core.submit_task(self, args, kwargs, opts)
+        out = w.core.submit_task(self, args, kwargs, opts)
+        if opts.num_returns == "streaming":
+            return out  # ObjectRefGenerator
         if opts.num_returns == 1:
-            return refs[0]
-        return refs
+            return out[0]
+        return out
 
     def bind(self, *args, **kwargs):
         """DAG-building entry (reference: python/ray/dag) — deferred node."""
