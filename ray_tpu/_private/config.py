@@ -62,6 +62,9 @@ _DEFINITIONS: Dict[str, Tuple[type, Any]] = {
     "object_pull_chunk_bytes": (int, 8 * 1024**2),
     # --- tasks ---
     "task_max_retries_default": (int, 3),
+    # producer pauses when this many yields sit unconsumed at the caller
+    # (reference: generator_backpressure_num_objects)
+    "streaming_generator_buffer_size": (int, 256),
     "actor_max_restarts_default": (int, 0),
     "max_pending_lease_requests_per_class": (int, 10),
     # how long a caller keeps resending an un-acked actor task while the

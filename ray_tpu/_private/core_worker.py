@@ -354,6 +354,7 @@ class CoreWorker(CoreRuntime):
         self.server.register("ActorTaskDone", self._handle_actor_task_done)
         self.server.register("StreamingYield", self._handle_streaming_yield)
         self.server.register("StreamingDone", self._handle_streaming_done)
+        self.server.register("StreamingCredit", self._handle_streaming_credit)
         self.server.register("Ping", lambda: "pong")
         self.server.start(self.loop_thread)
         self.address: Tuple[str, int] = (self.server.host, self.server.port)
@@ -1762,7 +1763,17 @@ class CoreWorker(CoreRuntime):
         with st.cv:
             st.arrived[index] = oid
             st.cv.notify_all()
-        return {"ok": True}
+            pending = len(st.arrived)
+        return {"ok": True, "pending": pending}
+
+    def _handle_streaming_credit(self, task_id_bin: bytes) -> dict:
+        """Producer-side backpressure poll: how many yields sit undelivered
+        in this consumer's buffer."""
+        st = self._streams.get(TaskID(task_id_bin))
+        if st is None:
+            return {"ok": False, "pending": 0}
+        with st.cv:
+            return {"ok": True, "pending": len(st.arrived)}
 
     def _handle_streaming_done(
         self, task_id_bin: bytes, count: int, error: Optional[bytes] = None

@@ -268,11 +268,16 @@ class LocalModeRuntime(CoreRuntime):
                     oid = ObjectID.from_index(task_id, idx + 1)
                     w.reference_counter.add_owned_object(oid)
                     self.store.put(oid, value)
+                    abandoned = False
                     with st.cv:
                         if st.total is not None:
-                            break  # abandoned
-                        st.arrived[idx] = oid
-                        st.cv.notify_all()
+                            abandoned = True  # consumer dropped the stream
+                        else:
+                            st.arrived[idx] = oid
+                            st.cv.notify_all()
+                    if abandoned:
+                        self.free_object(oid)
+                        break
                     idx += 1
                 with st.cv:
                     if st.total is None:

@@ -44,6 +44,24 @@ class ObjectRefGenerator:
         self._core = core
         self._task_id = task_id
         self._state = state
+        self._close_cb = None
+        self._close_fired = False
+
+    def _set_close_callback(self, cb) -> None:
+        """Invoked exactly once when the stream terminates (exhausted,
+        errored, or dropped) — e.g. Serve uses it to release the routing
+        slot the stream occupies."""
+        self._close_cb = cb
+
+    def _fire_close(self) -> None:
+        if self._close_fired:
+            return
+        self._close_fired = True
+        if self._close_cb is not None:
+            try:
+                self._close_cb()
+            except Exception:  # noqa: BLE001
+                pass
 
     def __iter__(self) -> "ObjectRefGenerator":
         return self
@@ -66,9 +84,11 @@ class ObjectRefGenerator:
                     return ObjectRef(oid, owner_addr=self._core.address)
                 if st.error is not None:
                     self._core._streams.pop(self._task_id, None)
+                    self._fire_close()
                     raise st.error
                 if st.total is not None and st.next_index >= st.total:
                     self._core._streams.pop(self._task_id, None)
+                    self._fire_close()
                     raise StopIteration
                 remaining = None if deadline is None else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
@@ -90,6 +110,7 @@ class ObjectRefGenerator:
         # then stops producing) — without this a dropped generator pins
         # every yield for the life of the driver
         try:
+            self._fire_close()
             abandon = getattr(self._core, "_abandon_stream", None)
             if abandon is not None:
                 abandon(self._task_id)

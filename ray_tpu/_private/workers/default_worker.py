@@ -70,7 +70,9 @@ class _ActorRunner:
             has_async_methods,
         )
 
-        self.is_async = has_async_methods(instance)
+        # inspect the CLASS, not the instance: dir+getattr on the instance
+        # would execute @property getters during actor init
+        self.is_async = has_async_methods(type(instance))
         if self.is_async and max_concurrency <= 1:
             max_concurrency = ASYNC_ACTOR_DEFAULT_CONCURRENCY
         self.max_concurrency = max(1, max_concurrency)
@@ -325,6 +327,23 @@ def _execute_streaming(
             if not (rep or {}).get("ok", True):
                 break  # consumer abandoned the stream — stop producing
             idx += 1
+            # consumer backpressure: pause while the un-consumed buffer on
+            # the caller is deep (reference: generator_backpressure_num_
+            # objects); the registration ack alone doesn't bound it
+            limit = config.streaming_generator_buffer_size
+            while (rep or {}).get("pending", 0) >= limit:
+                time.sleep(0.02)
+                try:
+                    rep = client.call(
+                        "StreamingCredit", task_id_bin=task_id.binary(), timeout=30
+                    )
+                except Exception:  # noqa: BLE001
+                    break
+                if not rep.get("ok", True):
+                    rep = {"ok": False}
+                    break
+            if not (rep or {}).get("ok", True):
+                break
         done = {"count": idx, "error": None}
     except BaseException as e:  # noqa: BLE001
         tb = traceback.format_exc()

@@ -1,8 +1,10 @@
-"""Serve control plane: controller actor + replica actors + HTTP proxy.
+"""Serve control plane: controller actor + replica actors.
 
 Reference: ServeController (serve/_private/controller.py:127) reconciles
 DeploymentState (deployment_state.py:2820); replicas are plain actors
-(replica.py:1554); ProxyActor serves HTTP ingress (proxy.py:1098).
+(replica.py:1554 handle_request, :1630 streaming); queue-depth autoscaling
+from handle-reported metrics (autoscaling_state.py:340); config fan-out via
+long-poll push (long_poll.py:318).
 
 TPU notes: replicas request TPU resources through normal actor options —
 scheduling is the raylet's chip accounting; batching (serve/batching.py
@@ -11,8 +13,9 @@ here) is what keeps the MXU busy.
 
 from __future__ import annotations
 
-import json
+import math
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
@@ -20,7 +23,6 @@ from ray_tpu.serve.deployment import (
     Application,
     Deployment,
     DeploymentHandle,
-    _ReplicaSet,
 )
 
 CONTROLLER_NAME = "__serve_controller"
@@ -29,7 +31,7 @@ CONTROLLER_NAME = "__serve_controller"
 @ray_tpu.remote
 class Replica:
     """Hosts one copy of the deployment callable (reference:
-    serve/_private/replica.py:1554 handle_request)."""
+    serve/_private/replica.py:1554 handle_request, :1630 streaming)."""
 
     def __init__(self, serialized_target: bytes, init_args, init_kwargs,
                  user_config: Optional[Dict] = None):
@@ -42,11 +44,39 @@ class Replica:
             self._callable = target
         if user_config is not None and hasattr(self._callable, "reconfigure"):
             self._callable.reconfigure(user_config)
+        self._loop = None
+        self._loop_lock = threading.Lock()
+
+    def _maybe_await(self, out):
+        """Async deployment callables run on a per-replica event loop
+        (reference: replicas are fully async in serve/_private/replica.py)."""
+        import asyncio
+        import inspect
+
+        if not inspect.iscoroutine(out):
+            return out
+        with self._loop_lock:
+            if self._loop is None:
+                self._loop = asyncio.new_event_loop()
+                threading.Thread(
+                    target=self._loop.run_forever, daemon=True,
+                    name="replica-loop",
+                ).start()
+        return asyncio.run_coroutine_threadsafe(out, self._loop).result()
 
     def handle_request(self, method: str, args, kwargs):
         if method == "__call__":
-            return self._callable(*args, **kwargs)
-        return getattr(self._callable, method)(*args, **kwargs)
+            return self._maybe_await(self._callable(*args, **kwargs))
+        return self._maybe_await(getattr(self._callable, method)(*args, **kwargs))
+
+    def handle_request_streaming(self, method: str, args, kwargs):
+        """Generator method: the actor-streaming machinery turns each yield
+        into an ObjectRefGenerator item on the caller (replica.py:1630)."""
+        if method == "__call__":
+            out = self._callable(*args, **kwargs)
+        else:
+            out = getattr(self._callable, method)(*args, **kwargs)
+        yield from out
 
     def reconfigure(self, user_config: Dict) -> bool:
         if hasattr(self._callable, "reconfigure"):
@@ -57,65 +87,227 @@ class Replica:
         return True
 
 
-@ray_tpu.remote
+class _DeploymentState:
+    """Controller-side record for one deployment (reference:
+    deployment_state.py:2820, radically reduced)."""
+
+    def __init__(self, name: str, spec: dict):
+        self.name = name
+        self.spec = spec  # serialized_target, init_args/kwargs, options...
+        self.replicas: List[Any] = []
+        self.draining: List[tuple] = []  # (actor, kill_after_ts)
+        # handle-reported ongoing requests: handle_id -> (count, ts)
+        self.handle_metrics: Dict[str, tuple] = {}
+        self.last_scale_up = 0.0
+        self.last_scale_down = 0.0
+        self.version = 1
+
+    @property
+    def autoscaling(self) -> Optional[dict]:
+        return self.spec.get("autoscaling_config")
+
+    def total_ongoing(self, now: float) -> float:
+        return sum(
+            c for c, ts in self.handle_metrics.values() if now - ts < 5.0
+        )
+
+
+@ray_tpu.remote(max_concurrency=256)
 class ServeController:
-    """Reference: controller.py:127 — owns deployment → replica-actor map."""
+    """Reference: controller.py:127. A reconcile thread drives autoscaling;
+    long-poll listeners get pushed new replica sets (long_poll.py:318)."""
+
+    _RECONCILE_PERIOD_S = 0.25
+    _DRAIN_GRACE_S = 3.0
 
     def __init__(self):
-        self._deployments: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)  # notifies long-pollers
+        self._deployments: Dict[str, _DeploymentState] = {}
+        self._stopped = False
+        threading.Thread(
+            target=self._reconcile_loop, daemon=True, name="serve-reconcile"
+        ).start()
 
-    def deploy(self, name: str, serialized_target: bytes, init_args, init_kwargs,
-               num_replicas: int, max_ongoing_requests: int,
-               actor_options: Dict[str, Any], user_config: Optional[Dict]) -> List[Any]:
-        existing = self._deployments.get(name)
-        if existing:
-            for a in existing["replicas"]:
-                try:
-                    ray_tpu.kill(a)
-                except Exception:
-                    pass
-        replicas = [
-            Replica.options(
-                name=f"__serve_{name}_replica_{i}",
-                max_concurrency=max(2, max_ongoing_requests),
-                num_cpus=actor_options.get("num_cpus", 1),
-                num_tpus=actor_options.get("num_tpus", 0),
-                resources=actor_options.get("resources"),
-            ).remote(serialized_target, init_args, init_kwargs, user_config)
-            for i in range(num_replicas)
-        ]
-        # block until constructed so serve.run returns a live app
-        ray_tpu.get([r.health_check.remote() for r in replicas])
-        self._deployments[name] = {
-            "replicas": replicas,
-            "max_ongoing_requests": max_ongoing_requests,
-            "num_replicas": num_replicas,
-        }
-        return replicas
+    # -- deployment lifecycle ------------------------------------------
+    def deploy(self, name: str, spec: dict) -> dict:
+        # build the new state FULLY before publishing it — the reconcile
+        # loop must never see a half-deployed state (it would race the
+        # initial replica start and orphan actors)
+        st = _DeploymentState(name, spec)
+        auto = spec.get("autoscaling_config")
+        if auto is not None:
+            n = auto.get("initial_replicas")
+            if n is None:
+                n = auto.get("min_replicas", 1)
+        else:
+            n = spec["num_replicas"]
+        st.replicas = [self._start_replica(st) for i in range(n)]
+        ray_tpu.get([r.health_check.remote() for r in st.replicas], timeout=300)
+        st.version += 1
+        with self._lock:
+            old = self._deployments.get(name)
+            if old is not None:
+                # carry the old version's drain queue so its replicas are
+                # still reaped; retire its serving replicas now
+                st.draining.extend(old.draining)
+                now = time.monotonic()
+                st.draining.extend(
+                    (a, now + self._DRAIN_GRACE_S) for a in old.replicas
+                )
+            self._deployments[name] = st
+            self._cv.notify_all()
+        return self._snapshot_locked_free(name)
 
-    def get_deployment(self, name: str) -> Optional[Dict[str, Any]]:
-        d = self._deployments.get(name)
-        if d is None:
-            return None
-        return {"replicas": d["replicas"], "max_ongoing_requests": d["max_ongoing_requests"]}
+    def _start_replica(self, st: _DeploymentState):
+        spec = st.spec
+        opts = spec.get("ray_actor_options") or {}
+        return Replica.options(
+            max_concurrency=max(2, spec["max_ongoing_requests"]),
+            num_cpus=opts.get("num_cpus"),
+            num_tpus=opts.get("num_tpus", 0),
+            resources=opts.get("resources"),
+        ).remote(
+            spec["serialized_target"], spec["init_args"], spec["init_kwargs"],
+            spec.get("user_config"),
+        )
 
-    def list_deployments(self) -> List[str]:
-        return list(self._deployments)
+    def _kill(self, actor) -> None:
+        try:
+            ray_tpu.kill(actor)
+        except Exception:  # noqa: BLE001
+            pass
 
     def delete(self, name: str) -> bool:
-        d = self._deployments.pop(name, None)
-        if d:
-            for a in d["replicas"]:
-                try:
-                    ray_tpu.kill(a)
-                except Exception:
-                    pass
-        return d is not None
+        with self._lock:
+            st = self._deployments.pop(name, None)
+            if st is not None:
+                self._cv.notify_all()
+        if st:
+            for a in st.replicas:
+                self._kill(a)
+            for a, _ in st.draining:
+                self._kill(a)
+        return st is not None
 
     def shutdown(self) -> bool:
+        with self._lock:
+            self._stopped = True
         for name in list(self._deployments):
             self.delete(name)
         return True
+
+    def list_deployments(self) -> List[str]:
+        with self._lock:
+            return list(self._deployments)
+
+    # -- handle-facing --------------------------------------------------
+    def _snapshot_locked_free(self, name: str) -> Optional[dict]:
+        with self._lock:
+            st = self._deployments.get(name)
+            if st is None:
+                return None
+            return {
+                "replicas": list(st.replicas),
+                "max_ongoing_requests": st.spec["max_ongoing_requests"],
+                "version": st.version,
+                "streaming_methods": st.spec.get("streaming_methods", []),
+            }
+
+    def get_deployment(self, name: str) -> Optional[dict]:
+        return self._snapshot_locked_free(name)
+
+    def listen_for_change(self, name: str, known_version: int,
+                          timeout_s: float = 20.0) -> Optional[dict]:
+        """Long-poll: block until the deployment's version moves past
+        known_version (reference: LongPollHost long_poll.py:318)."""
+        deadline = time.monotonic() + timeout_s
+        with self._cv:
+            while True:
+                st = self._deployments.get(name)
+                if st is None:
+                    return None
+                if st.version > known_version:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cv.wait(timeout=remaining)
+        return self._snapshot_locked_free(name)
+
+    def report_handle_metrics(self, name: str, handle_id: str, ongoing: float) -> bool:
+        """Handles push their in-flight request counts; this is the
+        autoscaler's signal (reference: autoscaling_state.py:340)."""
+        with self._lock:
+            st = self._deployments.get(name)
+            if st is None:
+                return False
+            st.handle_metrics[handle_id] = (float(ongoing), time.monotonic())
+        return True
+
+    # -- autoscaling reconcile (reference: autoscaling_state.py:340) ----
+    def _reconcile_loop(self) -> None:
+        while True:
+            time.sleep(self._RECONCILE_PERIOD_S)
+            with self._lock:
+                if self._stopped:
+                    return
+                states = list(self._deployments.values())
+            for st in states:
+                try:
+                    self._reconcile_one(st)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _reconcile_one(self, st: _DeploymentState) -> None:
+        now = time.monotonic()
+        # reap drained replicas; drop handle-metrics entries gone silent
+        with self._lock:
+            ripe = [a for a, ts in st.draining if now >= ts]
+            st.draining = [(a, ts) for a, ts in st.draining if now < ts]
+            st.handle_metrics = {
+                h: (c, ts) for h, (c, ts) in st.handle_metrics.items()
+                if now - ts < 30.0
+            }
+        for a in ripe:
+            self._kill(a)
+        auto = st.autoscaling
+        if not auto:
+            return
+        target = max(0.1, float(auto.get("target_ongoing_requests", 2.0)))
+        lo = int(auto.get("min_replicas", 1))
+        hi = int(auto.get("max_replicas", 8))
+        up_delay = float(auto.get("upscale_delay_s", 0.5))
+        down_delay = float(auto.get("downscale_delay_s", 2.0))
+        with self._lock:
+            ongoing = st.total_ongoing(now)
+            n = len(st.replicas)
+        desired = min(hi, max(lo, math.ceil(ongoing / target)))
+        if desired > n and now - st.last_scale_up >= up_delay:
+            new = [self._start_replica(st) for _ in range(desired - n)]
+            try:
+                ray_tpu.get([r.health_check.remote() for r in new], timeout=300)
+            except Exception:  # noqa: BLE001
+                for a in new:
+                    self._kill(a)
+                return
+            with self._lock:
+                st.replicas.extend(new)
+                st.version += 1
+                st.last_scale_up = now
+                self._cv.notify_all()
+        elif desired < n and now - st.last_scale_down >= down_delay:
+            with self._lock:
+                victims = st.replicas[desired:]
+                st.replicas = st.replicas[:desired]
+                # drain: handles stop routing after the version bump; the
+                # replica is killed after a grace for in-flight requests
+                st.draining.extend(
+                    (a, now + self._DRAIN_GRACE_S) for a in victims
+                )
+                st.version += 1
+                st.last_scale_down = now
+                self._cv.notify_all()
 
 
 # ---------------------------------------------------------------------------
@@ -137,35 +329,46 @@ def _controller():
 
 def run(app: Application, *, name: Optional[str] = None,
         route_prefix: Optional[str] = None, **_ignored) -> DeploymentHandle:
-    """Deploy the application; returns a handle (reference: serve.run
-    api.py:930)."""
+    """Deploy the application; returns a live-updating handle
+    (reference: serve.run api.py:930)."""
+    import inspect
+
     from ray_tpu._private.serialization import dumps_function
 
     dep: Deployment = app.deployment
     cfg = dep._config
+    target = dep._target
+    streaming_methods = []
+    if isinstance(target, type):
+        for m in dir(target):
+            if not m.startswith("_") or m == "__call__":
+                fn = getattr(target, m, None)
+                if callable(fn) and inspect.isgeneratorfunction(fn):
+                    streaming_methods.append(m)
+    elif inspect.isgeneratorfunction(target):
+        streaming_methods.append("__call__")
+    spec = {
+        "serialized_target": dumps_function(target),
+        "init_args": app.init_args,
+        "init_kwargs": app.init_kwargs,
+        "num_replicas": cfg.num_replicas,
+        "max_ongoing_requests": cfg.max_ongoing_requests,
+        "ray_actor_options": cfg.ray_actor_options,
+        "user_config": cfg.user_config,
+        "autoscaling_config": cfg.autoscaling_config,
+        "streaming_methods": streaming_methods,
+    }
     ctl = _controller()
-    replicas = ray_tpu.get(
-        ctl.deploy.remote(
-            cfg.name,
-            dumps_function(dep._target),
-            app.init_args,
-            app.init_kwargs,
-            cfg.num_replicas,
-            cfg.max_ongoing_requests,
-            cfg.ray_actor_options,
-            cfg.user_config,
-        )
-    )
-    rs = _ReplicaSet(replicas, cfg.max_ongoing_requests)
-    return DeploymentHandle(cfg.name, rs)
+    snapshot = ray_tpu.get(ctl.deploy.remote(cfg.name, spec), timeout=600)
+    return DeploymentHandle(cfg.name, ctl, snapshot)
 
 
 def get_app_handle(name: str) -> DeploymentHandle:
     ctl = _controller()
-    info = ray_tpu.get(ctl.get_deployment.remote(name))
-    if info is None:
+    snapshot = ray_tpu.get(ctl.get_deployment.remote(name))
+    if snapshot is None:
         raise ValueError(f"No deployment named {name!r}")
-    return DeploymentHandle(name, _ReplicaSet(info["replicas"], info["max_ongoing_requests"]))
+    return DeploymentHandle(name, ctl, snapshot)
 
 
 def delete(name: str) -> None:
@@ -173,13 +376,16 @@ def delete(name: str) -> None:
 
 
 def shutdown() -> None:
+    from ray_tpu.serve.http_proxy import stop_http_proxy
+
+    stop_http_proxy()
     ctl = getattr(_state, "controller", None)
     try:
         ctl = ctl or ray_tpu.get_actor(CONTROLLER_NAME)
     except Exception:
         return
     try:
-        ray_tpu.get(ctl.shutdown.remote())
+        ray_tpu.get(ctl.shutdown.remote(), timeout=60)
         ray_tpu.kill(ctl)
     except Exception:
         pass

@@ -1,9 +1,11 @@
 """Deployment decorator + handle (reference: serve/api.py @serve.deployment,
 serve/handle.py DeploymentHandle).
 
-A deployment is a replicated actor class; the handle routes calls to
+A deployment is a replicated actor class. The handle routes calls to
 replicas with power-of-two-choices on outstanding requests (reference:
-request_router/pow_2_router.py:27) tracked caller-side.
+request_router/pow_2_router.py:27), keeps its replica set fresh via a
+long-poll listener on the controller (long_poll.py:318), and pushes its
+in-flight counts back as the autoscaling signal (autoscaling_state.py:340).
 """
 
 from __future__ import annotations
@@ -11,6 +13,8 @@ from __future__ import annotations
 import dataclasses
 import random
 import threading
+import time
+import uuid
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
@@ -23,6 +27,9 @@ class DeploymentConfig:
     max_ongoing_requests: int = 16
     ray_actor_options: Dict[str, Any] = dataclasses.field(default_factory=dict)
     user_config: Optional[Dict[str, Any]] = None
+    # {"min_replicas", "max_replicas", "target_ongoing_requests",
+    #  "upscale_delay_s", "downscale_delay_s", "initial_replicas"}
+    autoscaling_config: Optional[Dict[str, Any]] = None
 
 
 class Deployment:
@@ -51,6 +58,8 @@ class Deployment:
                 cfg.ray_actor_options = v
             elif k == "user_config":
                 cfg.user_config = v
+            elif k == "autoscaling_config":
+                cfg.autoscaling_config = v
             else:
                 raise ValueError(f"Unknown deployment option {k}")
         return Deployment(self._target, cfg)
@@ -69,7 +78,9 @@ class Application:
 def deployment(_target=None, *, name: Optional[str] = None, num_replicas: int = 1,
                max_ongoing_requests: int = 16,
                ray_actor_options: Optional[Dict[str, Any]] = None,
-               user_config: Optional[Dict[str, Any]] = None, **_ignored):
+               user_config: Optional[Dict[str, Any]] = None,
+               autoscaling_config: Optional[Dict[str, Any]] = None,
+               **_ignored):
     """@serve.deployment (reference: serve/api.py)."""
 
     def deco(target):
@@ -79,6 +90,7 @@ def deployment(_target=None, *, name: Optional[str] = None, num_replicas: int = 
             max_ongoing_requests=max_ongoing_requests,
             ray_actor_options=ray_actor_options or {},
             user_config=user_config,
+            autoscaling_config=autoscaling_config,
         )
         return Deployment(target, cfg)
 
@@ -86,7 +98,7 @@ def deployment(_target=None, *, name: Optional[str] = None, num_replicas: int = 
 
 
 class _ReplicaSet:
-    """Caller-side routing state for one deployment."""
+    """Caller-side routing state for one deployment version."""
 
     def __init__(self, actors: List[Any], max_ongoing: int):
         self.actors = list(actors)
@@ -109,7 +121,12 @@ class _ReplicaSet:
 
     def release(self, idx: int) -> None:
         with self.lock:
-            self.outstanding[idx] -= 1
+            if 0 <= idx < len(self.outstanding):
+                self.outstanding[idx] -= 1
+
+    def total_outstanding(self) -> int:
+        with self.lock:
+            return sum(self.outstanding)
 
 
 class DeploymentResponse:
@@ -124,9 +141,12 @@ class DeploymentResponse:
         try:
             return ray_tpu.get(self._ref, timeout=timeout)
         finally:
-            if not self._done:
-                self._done = True
-                self._on_done()
+            self._release()
+
+    def _release(self):
+        if not self._done:
+            self._done = True
+            self._on_done()
 
     def _to_object_ref(self):
         return self._ref
@@ -134,28 +154,128 @@ class DeploymentResponse:
 
 class DeploymentHandle:
     """Reference: serve/handle.py:1041. handle.method.remote(args) →
-    DeploymentResponse; plain handle.remote() calls __call__."""
+    DeploymentResponse; plain handle.remote() calls __call__. Streaming
+    methods return an ObjectRefGenerator of per-yield refs.
 
-    def __init__(self, name: str, replica_set: _ReplicaSet):
+    Background threads keep the handle live: a long-poll listener swaps in
+    new replica sets when the controller scales the deployment, and a
+    metrics pusher reports this handle's in-flight counts (the autoscaling
+    signal)."""
+
+    _METRICS_PERIOD_S = 0.5
+
+    def __init__(self, name: str, controller, snapshot: dict):
         self._name = name
-        self._rs = replica_set
+        self._controller = controller
+        self._handle_id = uuid.uuid4().hex[:16]
+        self._version = snapshot["version"]
+        self._streaming_methods = set(snapshot.get("streaming_methods") or [])
+        self._rs = _ReplicaSet(snapshot["replicas"], snapshot["max_ongoing_requests"])
+        self._closed = False
+        # background threads hold only a WEAKREF to the handle — a strong
+        # self-reference would keep every (un)pickled handle, and its two
+        # threads plus its controller long-poll slot, alive forever
+        import weakref
 
+        ref = weakref.ref(self)
+        for fn, nm in ((_handle_long_poll_loop, "poll"), (_handle_metrics_loop, "metrics")):
+            threading.Thread(
+                target=fn, args=(ref,), daemon=True,
+                name=f"serve-handle-{nm}-{name}",
+            ).start()
+
+    def close(self) -> None:
+        """Stop the background threads; the handle stops tracking scaling."""
+        self._closed = True
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+    # -- calls ----------------------------------------------------------
     def __getattr__(self, method: str) -> "_HandleMethod":
         if method.startswith("_"):
             raise AttributeError(method)
-        return _HandleMethod(self._rs, method)
+        return _HandleMethod(self, method)
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
-        return _HandleMethod(self._rs, "__call__").remote(*args, **kwargs)
+    def remote(self, *args, **kwargs):
+        return _HandleMethod(self, "__call__").remote(*args, **kwargs)
+
+    def _call(self, method: str, args, kwargs):
+        rs = self._rs
+        idx = rs.pick()
+        actor = rs.actors[idx]
+        if method in self._streaming_methods:
+            gen = actor.handle_request_streaming.remote(method, args, kwargs)
+            # the stream holds the routing slot until it completes or is
+            # dropped — otherwise streaming load is invisible to pow-2
+            # routing and the autoscaler
+            gen._set_close_callback(lambda: rs.release(idx))
+            return gen
+        ref = actor.handle_request.remote(method, args, kwargs)
+        return DeploymentResponse(ref, on_done=lambda: rs.release(idx))
+
+    def __reduce__(self):
+        return (_rebuild_handle, (self._name,))
+
+    def __repr__(self) -> str:
+        return f"DeploymentHandle({self._name}, replicas={len(self._rs.actors)})"
+
+
+def _handle_long_poll_loop(handle_ref) -> None:
+    while True:
+        h = handle_ref()
+        if h is None or h._closed:
+            return
+        controller, name, version = h._controller, h._name, h._version
+        del h  # don't pin the handle across the blocking poll
+        try:
+            snap = ray_tpu.get(
+                controller.listen_for_change.remote(name, version, timeout_s=20.0),
+                timeout=40,
+            )
+        except Exception:  # noqa: BLE001
+            time.sleep(1.0)
+            continue
+        h = handle_ref()
+        if h is None or h._closed:
+            return
+        if snap is None:
+            time.sleep(1.0)  # deployment deleted (or being redeployed)
+            continue
+        if snap["version"] != h._version:
+            h._version = snap["version"]
+            h._streaming_methods = set(snap.get("streaming_methods") or [])
+            h._rs = _ReplicaSet(snap["replicas"], snap["max_ongoing_requests"])
+
+
+def _handle_metrics_loop(handle_ref) -> None:
+    while True:
+        time.sleep(DeploymentHandle._METRICS_PERIOD_S)
+        h = handle_ref()
+        if h is None or h._closed:
+            return
+        try:
+            h._controller.report_handle_metrics.remote(
+                h._name, h._handle_id, h._rs.total_outstanding()
+            )
+        except Exception:  # noqa: BLE001
+            pass
+        del h
+
+
+def _rebuild_handle(name: str) -> DeploymentHandle:
+    from ray_tpu.serve.controller import get_app_handle
+
+    return get_app_handle(name)
 
 
 class _HandleMethod:
-    def __init__(self, rs: _ReplicaSet, method: str):
-        self._rs = rs
+    def __init__(self, handle: DeploymentHandle, method: str):
+        self._handle = handle
         self._method = method
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
-        idx = self._rs.pick()
-        actor = self._rs.actors[idx]
-        ref = getattr(actor, "handle_request").remote(self._method, args, kwargs)
-        return DeploymentResponse(ref, on_done=lambda: self._rs.release(idx))
+    def remote(self, *args, **kwargs):
+        return self._handle._call(self._method, args, kwargs)

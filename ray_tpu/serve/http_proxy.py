@@ -1,75 +1,212 @@
-"""HTTP ingress for Serve deployments.
+"""HTTP ingress for Serve deployments — asyncio server with streaming.
 
 Reference: per-node ProxyActor ASGI app (serve/_private/proxy.py:1098,
-uvicorn/starlette). Here: a stdlib ThreadingHTTPServer that maps
-``POST /<deployment>`` with a JSON body to ``handle.remote(body)`` —
-dependency-free, good for the control path; heavy payloads should use
-handles directly (they ride the shared-memory object store).
+uvicorn/starlette). Re-built on asyncio streams (dependency-free):
+``POST /<deployment>`` with a JSON body dispatches to the deployment handle
+without blocking a thread per connection; streaming deployments respond
+with chunked transfer encoding, one JSON line per yielded value
+(reference: streamed replica responses, replica.py:1630).
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
-from ray_tpu.serve.controller import get_app_handle
 from ray_tpu.serve.deployment import DeploymentHandle
 
 
-class _Proxy:
-    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
-        self.handles: Dict[str, DeploymentHandle] = {}
-        proxy = self
+def _json_bytes(obj) -> bytes:
+    return json.dumps(obj).encode()
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):  # quiet
+
+class _AsyncProxy:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self.host = host
+        self.requested_port = port
+        self.port: Optional[int] = None
+        self.handles: Dict[str, DeploymentHandle] = {}
+        self._loop = asyncio.new_event_loop()
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._ready = threading.Event()
+        self._start_error: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="serve-http-proxy"
+        )
+        self._thread.start()
+        self._ready.wait(timeout=10)
+        if self._start_error is not None:
+            raise self._start_error
+        if self.port is None:
+            raise RuntimeError("HTTP proxy failed to start in time")
+
+    def _run(self) -> None:
+        asyncio.set_event_loop(self._loop)
+        try:
+            self._loop.run_until_complete(self._start())
+        except BaseException as e:  # noqa: BLE001 — surface bind errors
+            self._start_error = e
+            self._ready.set()
+            return
+        self._ready.set()
+        self._loop.run_forever()
+
+    async def _start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._on_client, self.host, self.requested_port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    def _get_handle(self, name: str) -> DeploymentHandle:
+        handle = self.handles.get(name)
+        if handle is None:
+            from ray_tpu.serve.controller import get_app_handle
+
+            handle = get_app_handle(name)
+            self.handles[name] = handle
+        return handle
+
+    # -- request handling ----------------------------------------------
+    async def _on_client(self, reader: asyncio.StreamReader,
+                         writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                request_line = await reader.readline()
+                if not request_line:
+                    return
+                try:
+                    method, path, _version = request_line.decode().split(None, 2)
+                except ValueError:
+                    return
+                headers: Dict[str, str] = {}
+                while True:
+                    line = await reader.readline()
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = line.decode().partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                body = b""
+                length = int(headers.get("content-length", 0))
+                if length:
+                    body = await reader.readexactly(length)
+                keep_alive = headers.get("connection", "keep-alive").lower() != "close"
+                await self._dispatch(method, path, body, writer)
+                if not keep_alive:
+                    return
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
                 pass
 
-            def do_POST(self):
-                name = self.path.strip("/").split("/")[0]
-                try:
-                    handle = proxy.handles.get(name)
-                    if handle is None:
-                        handle = get_app_handle(name)
-                        proxy.handles[name] = handle
-                    length = int(self.headers.get("Content-Length", 0))
-                    body = self.rfile.read(length)
-                    payload = json.loads(body) if body else None
-                    out = handle.remote(payload).result(timeout=60)
-                    data = json.dumps({"result": out}).encode()
-                    self.send_response(200)
-                except ValueError as e:
-                    data = json.dumps({"error": str(e)}).encode()
-                    self.send_response(404)
-                except Exception as e:  # noqa: BLE001
-                    data = json.dumps({"error": str(e)}).encode()
-                    self.send_response(500)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
+    async def _dispatch(self, method: str, path: str, body: bytes,
+                        writer: asyncio.StreamWriter) -> None:
+        name = path.strip("/").split("?")[0].split("/")[0]
+        loop = asyncio.get_event_loop()
+        try:
+            handle = await loop.run_in_executor(None, self._get_handle, name)
+            payload = json.loads(body) if body else None
+            result = await loop.run_in_executor(
+                None, lambda: handle.remote(payload) if payload is not None
+                else handle.remote()
+            )
+        except ValueError as e:
+            self._plain_response(writer, 404, _json_bytes({"error": str(e)}))
+            await writer.drain()
+            return
+        except Exception as e:  # noqa: BLE001
+            self._plain_response(writer, 500, _json_bytes({"error": str(e)}))
+            await writer.drain()
+            return
+        from ray_tpu._private.streaming import ObjectRefGenerator
 
-            do_GET = do_POST
+        if isinstance(result, ObjectRefGenerator):
+            await self._stream_response(writer, result)
+            return
+        try:
+            def _resolve():
+                return result.result(timeout=120)
 
-        self.server = ThreadingHTTPServer((host, port), Handler)
-        self.port = self.server.server_address[1]
-        self._thread = threading.Thread(target=self.server.serve_forever, daemon=True)
-        self._thread.start()
+            value = await loop.run_in_executor(None, _resolve)
+            self._plain_response(writer, 200, _json_bytes({"result": value}))
+        except Exception as e:  # noqa: BLE001
+            self._plain_response(writer, 500, _json_bytes({"error": str(e)}))
+        await writer.drain()
 
-    def stop(self):
-        self.server.shutdown()
+    def _plain_response(self, writer: asyncio.StreamWriter, status: int,
+                        data: bytes) -> None:
+        reason = {200: "OK", 404: "Not Found", 500: "Internal Server Error"}.get(
+            status, "OK"
+        )
+        writer.write(
+            f"HTTP/1.1 {status} {reason}\r\n"
+            f"Content-Type: application/json\r\n"
+            f"Content-Length: {len(data)}\r\n\r\n".encode() + data
+        )
+
+    async def _stream_response(self, writer: asyncio.StreamWriter, gen) -> None:
+        """Chunked transfer encoding: one JSON line per yielded value, sent
+        as each lands (the client sees results while the replica still
+        computes)."""
+        import ray_tpu
+
+        loop = asyncio.get_event_loop()
+        writer.write(
+            b"HTTP/1.1 200 OK\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Transfer-Encoding: chunked\r\n\r\n"
+        )
+        await writer.drain()
+
+        def _next_value():
+            try:
+                ref = next(gen)
+            except StopIteration:
+                return StopIteration
+            return ray_tpu.get(ref, timeout=120)
+
+        try:
+            while True:
+                value = await loop.run_in_executor(None, _next_value)
+                if value is StopIteration:
+                    break
+                chunk = _json_bytes(value) + b"\n"
+                writer.write(f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
+                await writer.drain()
+        except Exception as e:  # noqa: BLE001
+            chunk = _json_bytes({"error": str(e)}) + b"\n"
+            writer.write(f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
+        writer.write(b"0\r\n\r\n")
+        await writer.drain()
+
+    def stop(self) -> None:
+        def _close():
+            if self._server is not None:
+                self._server.close()
+            self._loop.stop()
+
+        try:
+            self._loop.call_soon_threadsafe(_close)
+        except Exception:  # noqa: BLE001
+            pass
 
 
-_proxy: Optional[_Proxy] = None
+_proxy: Optional[_AsyncProxy] = None
 
 
 def start_http_proxy(host: str = "127.0.0.1", port: int = 8000) -> int:
-    """Start the ingress; returns the bound port."""
+    """Start the ingress; returns the bound port. Raises if the port can't
+    be bound (a failed start is not cached)."""
     global _proxy
     if _proxy is None:
-        _proxy = _Proxy(host, port)
+        _proxy = _AsyncProxy(host, port)
+        if _proxy.port is None:
+            _proxy = None
+            raise RuntimeError("HTTP proxy failed to start")
     return _proxy.port
 
 
