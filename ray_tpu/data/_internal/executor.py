@@ -34,6 +34,23 @@ def _pack(fn: Callable) -> bytes:
     return dumps_function(fn)
 
 
+@ray_tpu.remote
+def _partition_block_fn(fn_bytes: bytes, block: Block, k: int, idx: int) -> Any:
+    """Map side of a distributed shuffle: fn(block, k, idx) -> k parts."""
+    from ray_tpu._private.serialization import loads_function
+
+    parts = loads_function(fn_bytes)(block, k, idx)
+    return parts if k > 1 else parts[0]
+
+
+@ray_tpu.remote
+def _reduce_parts_fn(fn_bytes: bytes, *parts: Block) -> Any:
+    """Reduce side: fn(list_of_parts) -> one output block."""
+    from ray_tpu._private.serialization import loads_function
+
+    return loads_function(fn_bytes)(list(parts))
+
+
 class Executor:
     """Maps block refs through per-block remote tasks with a bounded
     in-flight window, yielding result refs in order as they finish."""
@@ -62,3 +79,37 @@ class Executor:
                 yield window.pop(0)
         while window:
             yield window.pop(0)
+
+    def shuffle_refs(
+        self,
+        refs: List[Any],
+        partition_fn: Callable[[Block, int, int], List[Block]],
+        reduce_fn: Callable[[List[Block]], Block],
+        num_outputs: Optional[int] = None,
+        local: bool = False,
+    ) -> Iterator[Any]:
+        """Two-stage distributed shuffle (reference: map/reduce shuffle in
+        _internal/planner/{sort,random_shuffle}.py): each input block is
+        partitioned into k parts by a remote map task (which also receives
+        its block index — per-block RNG seeds need it); reduce task j
+        concatenates part j of every map output. Only REFS pass through
+        the driver — blocks never materialize here."""
+        refs = list(refs)
+        if not refs:
+            return
+        k = num_outputs if num_outputs is not None else len(refs)
+        k = max(1, k)
+        if local:
+            blocks = [ray_tpu.get(r) if hasattr(r, "id") else r for r in refs]
+            parts = [partition_fn(b, k, i) for i, b in enumerate(blocks)]
+            for j in range(k):
+                yield ray_tpu.put(reduce_fn([p[j] for p in parts]))
+            return
+        pfn_b = _pack(partition_fn)
+        rfn_b = _pack(reduce_fn)
+        part_refs: List[List[Any]] = []
+        for i, r in enumerate(refs):
+            out = _partition_block_fn.options(num_returns=k).remote(pfn_b, r, k, i)
+            part_refs.append(out if isinstance(out, list) else [out])
+        for j in range(k):
+            yield _reduce_parts_fn.remote(rfn_b, *[p[j] for p in part_refs])

@@ -50,11 +50,20 @@ class _MapBlocks(_Op):
         self.name = name
 
 
-class _AllToAll(_Op):
-    """Barrier op: takes ALL input blocks, returns new blocks."""
+class _Shuffle(_Op):
+    """All-to-all op as a distributed two-stage shuffle: ``partition_fn``
+    splits each block into k parts (map tasks), ``reduce_fn`` merges part
+    j of every block (reduce tasks). ``prepare`` may inspect the input
+    refs first (e.g. sort boundary sampling) and returns the actual
+    partition fn. Blocks never materialize on the driver (reference:
+    _internal/planner/{sort,random_shuffle}.py)."""
 
-    def __init__(self, fn: Callable[[List[Block]], List[Block]], name: str):
-        self.fn = fn
+    def __init__(self, partition_fn, reduce_fn, name: str,
+                 num_outputs: Optional[int] = None, prepare=None):
+        self.partition_fn = partition_fn
+        self.reduce_fn = reduce_fn
+        self.num_outputs = num_outputs
+        self.prepare = prepare
         self.name = name
 
 
@@ -143,43 +152,88 @@ class Dataset:
     def limit(self, n: int) -> "Dataset":
         return self._with(_Limit(n))
 
-    # -- all-to-all ----------------------------------------------------
+    # -- all-to-all (distributed two-stage shuffles) -------------------
     def repartition(self, num_blocks: int) -> "Dataset":
-        def _repart(blocks: List[Block]) -> List[Block]:
-            whole = block_concat(blocks)
-            n = block_num_rows(whole)
-            if n == 0:
-                return []
-            splits = np.array_split(np.arange(n), num_blocks)
-            return [block_take(whole, idx) for idx in splits if len(idx)]
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
 
-        return self._with(_AllToAll(_repart, f"Repartition({num_blocks})"))
+        def _part(block: Block, k: int, idx: int) -> List[Block]:
+            n = block_num_rows(block)
+            return [block_take(block, i) for i in np.array_split(np.arange(n), k)]
+
+        return self._with(_Shuffle(
+            _part, block_concat, f"Repartition({num_blocks})",
+            num_outputs=num_blocks,
+        ))
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
-        def _shuf(blocks: List[Block]) -> List[Block]:
-            whole = block_concat(blocks)
-            n = block_num_rows(whole)
-            if n == 0:
-                return []
-            rng = np.random.RandomState(seed)
-            perm = rng.permutation(n)
-            k = max(1, len(blocks))
-            return [block_take(whole, idx) for idx in np.array_split(perm, k)]
+        def _part(block: Block, k: int, idx: int) -> List[Block]:
+            n = block_num_rows(block)
+            # per-BLOCK-INDEX rng: every block must draw a different
+            # assignment stream or same-offset rows stay co-located
+            rng = np.random.RandomState(
+                None if seed is None else (seed * 1_000_003 + idx) % (2**31)
+            )
+            assign = rng.randint(0, k, size=n)
+            return [block_take(block, np.where(assign == j)[0]) for j in range(k)]
 
-        return self._with(_AllToAll(_shuf, "RandomShuffle"))
+        def _reduce(parts: List[Block]) -> Block:
+            merged = block_concat(parts)
+            n = block_num_rows(merged)
+            if not n:
+                return merged
+            rng = np.random.RandomState(seed)
+            return block_take(merged, rng.permutation(n))
+
+        return self._with(_Shuffle(_part, _reduce, "RandomShuffle"))
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":
-        def _sort(blocks: List[Block]) -> List[Block]:
-            whole = block_concat(blocks)
-            if not block_num_rows(whole):
-                return []
-            order = np.argsort(whole[key], kind="stable")
+        def _prepare(refs: List[Any]) -> Callable:
+            # sample keys from each block to pick range boundaries
+            # (reference: sample-based sort partitioning, planner/sort.py)
+            def _sample(block: Block) -> Block:
+                vals = block.get(key)
+                if vals is None or not len(vals):
+                    return {}
+                idx = np.linspace(0, len(vals) - 1, min(64, len(vals))).astype(int)
+                return {"s": np.asarray(vals)[idx]}
+
+            samp_refs = list(self._executor.map_refs(_sample, iter(refs),
+                                                     local=_use_local_exec()))
+            sample_arrays = [
+                s["s"] for s in (ray_tpu.get(r) for r in samp_refs) if s
+            ]
+            samples = np.concatenate(sample_arrays) if sample_arrays else np.array([])
+            # boundaries once here, not per map task; evenly-spaced order
+            # statistics (not np.quantile) so string keys sort too
+            k_out = max(1, len(refs))
+            if len(samples):
+                ss = np.sort(samples)
+                cut = np.linspace(0, len(ss) - 1, k_out + 1).astype(int)[1:-1]
+                bounds = ss[cut]
+            else:
+                bounds = samples
+
+            def _part(block: Block, k: int, idx: int) -> List[Block]:
+                if not block_num_rows(block):
+                    return [block] * k
+                assign = np.searchsorted(bounds, block[key], side="right")
+                if descending:
+                    assign = (k - 1) - assign  # reversed range order
+                return [block_take(block, np.where(assign == j)[0]) for j in range(k)]
+
+            return _part
+
+        def _reduce(parts: List[Block]) -> Block:
+            merged = block_concat(parts)
+            if not block_num_rows(merged):
+                return merged
+            order = np.argsort(merged[key], kind="stable")
             if descending:
                 order = order[::-1]
-            k = max(1, len(blocks))
-            return [block_take(whole, idx) for idx in np.array_split(order, k)]
+            return block_take(merged, order)
 
-        return self._with(_AllToAll(_sort, f"Sort({key})"))
+        return self._with(_Shuffle(None, _reduce, f"Sort({key})", prepare=_prepare))
 
     def groupby(self, key: str) -> "GroupedData":
         return GroupedData(self, key)
@@ -222,10 +276,15 @@ class Dataset:
 
                 refs = self._executor.map_refs(chain, refs, local=local)
                 i = j
-            elif isinstance(op, _AllToAll):
-                blocks = [ray_tpu.get(r) for r in refs]
-                out_blocks = op.fn(blocks)
-                refs = iter([ray_tpu.put(b) for b in out_blocks])
+            elif isinstance(op, _Shuffle):
+                in_refs = list(refs)
+                part_fn = op.partition_fn
+                if op.prepare is not None:
+                    part_fn = op.prepare(in_refs)
+                refs = self._executor.shuffle_refs(
+                    in_refs, part_fn, op.reduce_fn,
+                    num_outputs=op.num_outputs, local=local,
+                )
                 i += 1
             elif isinstance(op, _Limit):
                 refs = _limit_refs(refs, op.n)
@@ -363,6 +422,33 @@ class Dataset:
             Dataset([ray_tpu.put(block_take(whole, idx[k:]))]),
         )
 
+    # -- writers ---------------------------------------------------------
+    def _write_files(self, path: str, fmt: str) -> List[str]:
+        """One file per output block, written by remote tasks (reference:
+        Dataset.write_parquet/write_csv)."""
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        local = _use_local_exec()
+        out_refs = []
+        for i, r in enumerate(self._iter_output_refs()):
+            fpath = os.path.join(path, f"part-{i:05d}.{fmt}")
+            if local:
+                _write_block_file._function(ray_tpu.get(r), fpath, fmt)
+                out_refs.append(fpath)
+            else:
+                out_refs.append(_write_block_file.remote(r, fpath, fmt))
+        return [p if isinstance(p, str) else ray_tpu.get(p) for p in out_refs]
+
+    def write_parquet(self, path: str) -> List[str]:
+        return self._write_files(path, "parquet")
+
+    def write_csv(self, path: str) -> List[str]:
+        return self._write_files(path, "csv")
+
+    def write_json(self, path: str) -> List[str]:
+        return self._write_files(path, "json")
+
     def __repr__(self) -> str:
         names = [getattr(op, "name", type(op).__name__) for op in self._ops]
         return f"Dataset(blocks={len(self._source_refs)}, plan={' -> '.join(names) or 'source'})"
@@ -371,25 +457,46 @@ class Dataset:
 
 
 class GroupedData:
-    """Sort-based groupby (reference: data grouped_data.py)."""
+    """Hash-shuffle groupby: rows hash-partition by key (map tasks), each
+    reduce task aggregates its partition's groups — no driver
+    materialization (reference: hash-shuffle groupby,
+    _internal/gpu_shuffle/hash_shuffle.py re-imagined for CPU blocks)."""
 
     def __init__(self, ds: Dataset, key: str):
         self._ds = ds
         self._key = key
 
     def _agg(self, agg_fn: Callable[[Block], Dict[str, Any]], suffix: str) -> Dataset:
-        whole = self._ds.materialize_block()
-        if not block_num_rows(whole):
-            return Dataset([])
-        keys = whole[self._key]
-        uniq, inverse = np.unique(keys, return_inverse=True)
-        rows = []
-        for gi, kv in enumerate(uniq):
-            grp = block_take(whole, np.where(inverse == gi)[0])
-            row = {self._key: kv}
-            row.update(agg_fn(grp))
-            rows.append(row)
-        return Dataset([ray_tpu.put(block_from_rows(rows))])
+        key = self._key
+
+        def _part(block: Block, k: int, idx: int) -> List[Block]:
+            n = block_num_rows(block)
+            if not n:
+                return [block] * k
+            vals = np.asarray(block[key])
+            if vals.dtype.kind in "iub":
+                assign = vals.astype(np.int64) % k
+            else:
+                # stable across processes (PYTHONHASHSEED-independent)
+                from pandas.util import hash_array
+
+                assign = (hash_array(vals) % k).astype(np.int64)
+            return [block_take(block, np.where(assign == j)[0]) for j in range(k)]
+
+        def _reduce(parts: List[Block]) -> Block:
+            merged = block_concat(parts)
+            if not block_num_rows(merged):
+                return {}
+            uniq, inverse = np.unique(merged[key], return_inverse=True)
+            rows = []
+            for gi, kv in enumerate(uniq):
+                grp = block_take(merged, np.where(inverse == gi)[0])
+                row = {key: kv}
+                row.update(agg_fn(grp))
+                rows.append(row)
+            return block_from_rows(rows)
+
+        return self._ds._with(_Shuffle(_part, _reduce, f"GroupBy({key})"))
 
     def count(self) -> Dataset:
         return self._agg(lambda g: {"count()": block_num_rows(g)}, "count")
@@ -405,6 +512,29 @@ class GroupedData:
 
     def min(self, col: str) -> Dataset:
         return self._agg(lambda g: {f"min({col})": g[col].min()}, "min")
+
+
+@ray_tpu.remote
+def _write_block_file(block: Block, path: str, fmt: str) -> str:
+    if fmt == "parquet":
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        pq.write_table(
+            pa.table({k: list(v) if v.ndim > 1 else v for k, v in block.items()}),
+            path,
+        )
+    elif fmt in ("csv", "json"):
+        import pandas as pd
+
+        df = pd.DataFrame({k: list(v) if v.ndim > 1 else v for k, v in block.items()})
+        if fmt == "csv":
+            df.to_csv(path, index=False)
+        else:
+            df.to_json(path, orient="records", lines=True)
+    else:
+        raise ValueError(f"unknown format {fmt}")
+    return path
 
 
 def _limit_refs(refs: Iterator[Any], n: int) -> Iterator[Any]:
