@@ -212,6 +212,7 @@ class _ActorDispatcher:
                     "aid": self.aid,
                     "return_oids": return_oids,
                     "addr": addr,
+                    "method": payload.get("method_name", "actor_task"),
                     "ts": time.monotonic(),
                 }
             try:
@@ -304,6 +305,7 @@ class _ActorDispatcher:
                 tid.binary(), reply["returns"],
                 streaming_done=reply.get("streaming_done"),
                 stream_error=reply.get("stream_error"),
+                failed=bool(reply.get("failed")),
             )
         elif status == "unknown":
             self.core._fail_actor_task(
@@ -410,6 +412,19 @@ class CoreWorker(CoreRuntime):
             w.reference_counter.set_borrow_release_callback(self._on_borrow_released)
 
         self._shutdown = False
+        # task-event buffer → GCS (reference: task_event_buffer.h feeding
+        # GcsTaskManager; drives the state API's task listings)
+        self._task_events: List[dict] = []
+        self._task_events_lock = threading.Lock()
+        threading.Thread(
+            target=self._task_event_flush_loop, daemon=True,
+            name="task-events",
+        ).start()
+        if is_driver and config.log_to_driver:
+            threading.Thread(
+                target=self._log_to_driver_loop, daemon=True,
+                name="log-to-driver",
+            ).start()
         # owner-side borrower liveness sweep (dead borrowers must not pin
         # objects forever; reference: WaitForRefRemoved)
         self._borrower_ping_failures: Dict[Tuple[str, int], int] = {}
@@ -418,6 +433,54 @@ class CoreWorker(CoreRuntime):
             name="borrower-sweep",
         )
         t.start()
+
+    # ==================================================================
+    # Task events (reference: task_event_buffer.h → GcsTaskManager)
+    # ==================================================================
+    def _record_task_event(self, task_id: TaskID, name: str, state: str,
+                           kind: str = "task") -> None:
+        ev = {
+            "task_id": task_id.hex(),
+            "name": name,
+            "state": state,  # SUBMITTED | FINISHED | FAILED
+            "kind": kind,  # task | actor_task
+            "job_id": self.job_id.hex(),
+            "worker": self.worker_id_hex[:16],
+            "ts": time.time(),
+        }
+        with self._task_events_lock:
+            self._task_events.append(ev)
+            if len(self._task_events) > 10_000:
+                del self._task_events[:5_000]
+
+    def _task_event_flush_loop(self) -> None:
+        while not self._shutdown:
+            time.sleep(1.0)
+            with self._task_events_lock:
+                batch, self._task_events = self._task_events, []
+            if not batch:
+                continue
+            try:
+                self.gcs.call_oneway("ReportTaskEvents", events=batch)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _log_to_driver_loop(self) -> None:
+        """Print worker log lines on the driver (reference:
+        _private/log_monitor.py tailing worker logs to the driver)."""
+        import sys
+
+        seq = 0
+        while not self._shutdown:
+            time.sleep(1.0)
+            try:
+                reply = self.gcs.call("GetLogs", after_seq=seq, timeout=10)
+            except Exception:  # noqa: BLE001
+                continue
+            for s, node_id, worker_id, line in reply.get("lines", []):
+                seq = max(seq, s)
+                print(f"({worker_id[:8]} {node_id[:8]}) {line}",
+                      file=sys.stderr)
 
     # ==================================================================
     # Owner-side object services
@@ -1154,6 +1217,7 @@ class CoreWorker(CoreRuntime):
         for oid in return_ids:
             self._ref_counter().add_owned_object(oid, pending_creation=True)
         self._pending_tasks[task_id] = {"spec": spec, "retries_left": spec.max_retries}
+        self._record_task_event(task_id, spec.function_descriptor.repr_name, "SUBMITTED")
         gen = self._register_stream(task_id) if streaming else None
         self.loop_thread.call_soon(self._submit_spec_threadsafe, spec)
         if streaming:
@@ -1386,6 +1450,8 @@ class CoreWorker(CoreRuntime):
                 self.memory_store.put(oid, ("inline", data))
             self._release_task_refs(spec)
             self._pending_tasks.pop(spec.task_id, None)
+            self._record_task_event(
+                spec.task_id, spec.function_descriptor.repr_name, "FAILED")
 
     def _complete_task(self, spec: TaskSpec, reply: dict) -> None:
         if spec.is_streaming_generator:
@@ -1398,6 +1464,9 @@ class CoreWorker(CoreRuntime):
             )
             self._release_task_refs(spec)
             self._pending_tasks.pop(spec.task_id, None)
+            self._record_task_event(
+                spec.task_id, spec.function_descriptor.repr_name,
+                "FAILED" if reply.get("stream_error") else "FINISHED")
             return
         returns = reply.get("returns", [])
         retriable_error = reply.get("retriable_error")
@@ -1454,6 +1523,11 @@ class CoreWorker(CoreRuntime):
         else:
             self._release_task_refs(spec)
         self._pending_tasks.pop(spec.task_id, None)
+        # the worker sets retriable_error on ANY application exception; if
+        # it survives to here the retries are exhausted -> FAILED
+        self._record_task_event(
+            spec.task_id, spec.function_descriptor.repr_name,
+            "FAILED" if retriable_error else "FINISHED")
 
     # ==================================================================
     # Object recovery (reference: object_recovery_manager.h:41 — the owner
@@ -1690,6 +1764,7 @@ class CoreWorker(CoreRuntime):
             "caller_addr": self.address,
         }
         gen = self._register_stream(task_id) if streaming else None
+        self._record_task_event(task_id, method_name, "SUBMITTED", kind="actor_task")
         self._get_dispatcher(aid).submit(payload, return_ids)
         if streaming:
             return gen
@@ -1706,6 +1781,7 @@ class CoreWorker(CoreRuntime):
     def _handle_actor_task_done(
         self, task_id_bin: bytes, returns: List[dict], dropped_borrows: list = None,
         streaming_done: Optional[int] = None, stream_error: Optional[bytes] = None,
+        failed: bool = False,
     ) -> dict:
         """Execution result pushed back by the actor's worker."""
         tid = TaskID(task_id_bin)
@@ -1732,6 +1808,9 @@ class CoreWorker(CoreRuntime):
                 self.memory_store.put(oid, ("inline", ret["data"]))
             else:
                 self.memory_store.put(oid, ("plasma", ret.get("node_id", self.node_id)))
+        self._record_task_event(
+            tid, info.get("method", "actor_task"),
+            "FAILED" if failed else "FINISHED", kind="actor_task")
         return {"ok": True}
 
     # ==================================================================
@@ -1819,10 +1898,13 @@ class CoreWorker(CoreRuntime):
 
     def _fail_actor_task(self, tid: TaskID, return_oids: List[ObjectID], err: Exception) -> None:
         with self._actor_pending_lock:
-            self._pending_actor_tasks.pop(tid, None)
+            info = self._pending_actor_tasks.pop(tid, None)
             contained = self._actor_task_contained.pop(tid, [])
         self._release_contained_refs(contained)
         self._fail_stream(tid, err)
+        self._record_task_event(
+            tid, (info or {}).get("method", "actor_task"), "FAILED",
+            kind="actor_task")
         data = serialize(err)
         for oid in return_oids:
             if not self.memory_store.contains(oid):

@@ -95,6 +95,14 @@ class GcsServer:
         self._raylet_clients: Dict[str, RpcClient] = {}
         self._actor_events: Dict[str, asyncio.Event] = {}
         self._node_version = 0
+        # observability (bounded): pushed metrics, task events, log lines
+        from collections import deque
+
+        self.metrics_by_producer: Dict[str, Tuple[List[dict], float]] = {}
+        self.task_events: Any = deque(maxlen=20000)
+        self.log_buffer: Any = deque(maxlen=50000)
+        self._log_seq = 0
+        self.metrics_http_port = 0
         self._load_persisted()
         self.server.register_instance(self)
 
@@ -722,11 +730,178 @@ class GcsServer:
         return {"ok": True}
 
     # ------------------------------------------------------------------
+    # Observability: metrics aggregation + Prometheus endpoint, task
+    # events, log buffering (reference: src/ray/stats/metric.h:104,
+    # GcsTaskManager task-event history, _private/log_monitor.py)
+    # ------------------------------------------------------------------
+    async def ReportMetrics(self, producer: str, metrics: List[dict]) -> dict:
+        self.metrics_by_producer[producer] = (metrics, time.monotonic())
+        return {"ok": True}
+
+    async def ReportTaskEvents(self, events: List[dict]) -> dict:
+        self.task_events.extend(events)
+        return {"ok": True}
+
+    async def ListTaskEvents(self, job_id: Optional[str] = None,
+                             limit: int = 1000) -> List[dict]:
+        out = [
+            e for e in self.task_events
+            if job_id is None or e.get("job_id") == job_id
+        ]
+        return out[-limit:]
+
+    async def PublishLogs(self, node_id: str, worker_id: str,
+                          lines: List[str]) -> dict:
+        for ln in lines:
+            self._log_seq += 1
+            self.log_buffer.append((self._log_seq, node_id, worker_id, ln))
+        return {"ok": True}
+
+    async def GetLogs(self, after_seq: int = 0, limit: int = 1000) -> dict:
+        """Worker log lines are cluster-wide (not scoped per job — worker
+        processes serve any job; the reference's per-job log routing is a
+        deliberate simplification here)."""
+        lines = [e for e in self.log_buffer if e[0] > after_seq][:limit]
+        next_seq = lines[-1][0] if lines else after_seq
+        return {"lines": lines, "next_seq": next_seq}
+
+    async def GetMetricsEndpoint(self) -> dict:
+        return {"host": "127.0.0.1", "port": self.metrics_http_port}
+
+    def _prometheus_text(self) -> str:
+        """Aggregated user metrics + built-in cluster gauges, Prometheus
+        text exposition format."""
+        out: List[str] = []
+
+        def emit(name, mtype, desc, series_fn):
+            out.append(f"# HELP {name} {desc}")
+            out.append(f"# TYPE {name} {mtype}")
+            out.extend(series_fn())
+
+        def esc(v: str) -> str:
+            return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+                    .replace("\n", "\\n"))
+
+        def fmt_tags(tags: Dict[str, str], extra: str = "") -> str:
+            items = [f'{k}="{esc(v)}"' for k, v in sorted(tags.items())]
+            if extra:
+                items.append(extra)
+            return "{" + ",".join(items) + "}" if items else ""
+
+        # built-ins
+        alive = sum(1 for n in self.nodes.values() if n.alive)
+        emit("ray_tpu_nodes_alive", "gauge", "Alive nodes",
+             lambda: [f"ray_tpu_nodes_alive {alive}"])
+        by_state: Dict[str, int] = {}
+        for a in self.actors.values():
+            by_state[a.state] = by_state.get(a.state, 0) + 1
+        emit("ray_tpu_actors", "gauge", "Actors by state", lambda: [
+            f'ray_tpu_actors{{state="{s}"}} {c}' for s, c in sorted(by_state.items())
+        ])
+        ev_state: Dict[str, int] = {}
+        for e in self.task_events:
+            ev_state[e.get("state", "?")] = ev_state.get(e.get("state", "?"), 0) + 1
+        emit("ray_tpu_task_events_total", "counter", "Task events seen", lambda: [
+            f'ray_tpu_task_events_total{{state="{s}"}} {c}'
+            for s, c in sorted(ev_state.items())
+        ])
+
+        # user metrics, merged across producers; producers gone silent for
+        # 30s (dead workers) are evicted so the endpoint stays bounded
+        now = time.monotonic()
+        self.metrics_by_producer = {
+            p: (m, ts) for p, (m, ts) in self.metrics_by_producer.items()
+            if now - ts < 30.0
+        }
+        merged: Dict[str, dict] = {}
+        for producer, (metrics, _ts) in self.metrics_by_producer.items():
+            for m in metrics:
+                ent = merged.setdefault(
+                    m["name"],
+                    {"type": m["type"], "description": m.get("description", ""),
+                     "bounds": m.get("bounds"), "series": {}},
+                )
+                if ent["type"] == "histogram" and m.get("bounds") != ent["bounds"]:
+                    continue  # mismatched boundaries can't be merged
+                for s in m.get("series", []):
+                    key = tuple(sorted(s["tags"].items()))
+                    if ent["type"] == "histogram":
+                        agg = ent["series"].setdefault(
+                            key, {"buckets": [0] * (len(ent["bounds"]) + 1),
+                                  "sum": 0.0, "count": 0})
+                        agg["buckets"] = [
+                            a + b for a, b in zip(agg["buckets"], s["buckets"])
+                        ]
+                        agg["sum"] += s["sum"]
+                        agg["count"] += s["count"]
+                    elif ent["type"] == "counter":
+                        agg = ent["series"].setdefault(key, {"value": 0.0})
+                        agg["value"] += s["value"]
+                    else:  # gauge: last writer wins
+                        ent["series"][key] = {"value": s["value"]}
+        for name, ent in sorted(merged.items()):
+            if ent["type"] == "histogram":
+                def lines(ent=ent, name=name):
+                    ls = []
+                    for key, s in ent["series"].items():
+                        tags = dict(key)
+                        cum = 0
+                        for bound, cnt in zip(ent["bounds"], s["buckets"]):
+                            cum += cnt
+                            ls.append(
+                                f"{name}_bucket{fmt_tags(tags, f'le=\"{bound}\"')} {cum}"
+                            )
+                        ls.append(
+                            f"{name}_bucket{fmt_tags(tags, 'le=\"+Inf\"')} {s['count']}"
+                        )
+                        ls.append(f"{name}_sum{fmt_tags(tags)} {s['sum']}")
+                        ls.append(f"{name}_count{fmt_tags(tags)} {s['count']}")
+                    return ls
+            else:
+                def lines(ent=ent, name=name):
+                    return [
+                        f"{name}{fmt_tags(dict(key))} {s['value']}"
+                        for key, s in ent["series"].items()
+                    ]
+            emit(name, ent["type"], ent["description"], lines)
+        return "\n".join(out) + "\n"
+
+    async def _serve_metrics_http(self) -> None:
+        """Tiny HTTP/1.0 responder: any GET returns the Prometheus text
+        (reference: the dashboard agent's Prometheus scrape endpoint)."""
+
+        async def on_client(reader, writer):
+            try:
+                await reader.readline()  # request line; drain headers
+                while True:
+                    line = await reader.readline()
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+                body = self._prometheus_text().encode()
+                writer.write(
+                    b"HTTP/1.0 200 OK\r\n"
+                    b"Content-Type: text/plain; version=0.0.4\r\n"
+                    + f"Content-Length: {len(body)}\r\n\r\n".encode() + body
+                )
+                await writer.drain()
+            except Exception:  # noqa: BLE001
+                pass
+            finally:
+                try:
+                    writer.close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+        server = await asyncio.start_server(on_client, "127.0.0.1", 0)
+        self.metrics_http_port = server.sockets[0].getsockname()[1]
+        logger.info("metrics endpoint on :%d", self.metrics_http_port)
+
     async def Ping(self) -> str:
         return "pong"
 
     async def run(self) -> None:
         asyncio.ensure_future(self._health_check_loop())
+        await self._serve_metrics_http()
         await self.server.serve_forever()
 
 

@@ -908,6 +908,58 @@ class Raylet:
                             pass
             self._kick_drain()
 
+    async def _log_tail_loop(self) -> None:
+        """Tail this node's worker log files and push appended lines to the
+        GCS log buffer (reference: _private/log_monitor.py), where the
+        driver's log-to-driver thread picks them up."""
+        offsets: Dict[str, int] = {}
+        loop = asyncio.get_event_loop()
+        while True:
+            await asyncio.sleep(1.0)
+
+            def _collect():
+                batches = []
+                try:
+                    names = os.listdir(self.session_dir)
+                except OSError:
+                    return batches
+                for fname in names:
+                    if not (fname.startswith("worker-") and fname.endswith(".log")):
+                        continue
+                    path = os.path.join(self.session_dir, fname)
+                    try:
+                        size = os.path.getsize(path)
+                        off = offsets.get(fname, 0)
+                        if size <= off:
+                            continue
+                        with open(path, "rb") as f:
+                            f.seek(off)
+                            data = f.read(64 * 1024)
+                        # only consume complete lines: a partial trailing
+                        # line (mid-write, or chunk-cap split) stays for
+                        # the next cycle
+                        cut = data.rfind(b"\n")
+                        if cut < 0:
+                            continue
+                        data = data[: cut + 1]
+                        offsets[fname] = off + len(data)
+                        lines = data.decode(errors="replace").splitlines()
+                        if lines:
+                            batches.append((fname[len("worker-"):-len(".log")], lines))
+                    except OSError:
+                        continue
+                return batches
+
+            batches = await loop.run_in_executor(None, _collect)
+            for worker_id, lines in batches:
+                try:
+                    await self.gcs.acall(
+                        "PublishLogs", node_id=self.node_id,
+                        worker_id=worker_id, lines=lines, timeout=10,
+                    )
+                except Exception:  # noqa: BLE001
+                    pass
+
     async def _idle_reaper_loop(self) -> None:
         while True:
             await asyncio.sleep(5)
@@ -959,6 +1011,8 @@ class Raylet:
         asyncio.ensure_future(self._idle_reaper_loop())
         asyncio.ensure_future(self._drain_loop())
         asyncio.ensure_future(self._pull_pin_sweeper_loop())
+        if config.log_to_driver:
+            asyncio.ensure_future(self._log_tail_loop())
         if config.worker_pool_prestart_workers:
             for _ in range(int(self.resources.total.get("CPU", 1))):
                 self._spawn_worker()

@@ -130,6 +130,8 @@ class _ActorRunner:
                     "returns": result["returns"],
                     "streaming_done": result.get("streaming_done"),
                     "stream_error": result.get("stream_error"),
+                    "failed": bool(result.get("retriable_error")
+                                   or result.get("stream_error")),
                 }
             if task_id_bin in self.inflight:
                 return {"status": "running"}
@@ -176,6 +178,8 @@ class _ActorRunner:
                     # finalizer in case the StreamingDone push was lost
                     streaming_done=result.get("streaming_done"),
                     stream_error=result.get("stream_error"),
+                    failed=bool(result.get("retriable_error")
+                                or result.get("stream_error")),
                     timeout=30,
                 )
                 with self.lock:

@@ -46,6 +46,32 @@ def list_jobs() -> List[Dict[str, Any]]:
     return _gcs().call_retrying("ListJobs")
 
 
+def list_tasks(job_id: Optional[str] = None, limit: int = 1000) -> List[Dict[str, Any]]:
+    """Recent task lifecycle events (reference: util/state/api.py:1020
+    list_tasks over GcsTaskManager)."""
+    return _gcs().call_retrying("ListTaskEvents", job_id=job_id, limit=limit)
+
+
+def task_summary() -> Dict[str, int]:
+    """Task counts by state (SUBMITTED minus FINISHED/FAILED ≈ running)."""
+    counts: Dict[str, int] = {}
+    for e in list_tasks(limit=20000):
+        counts[e["state"]] = counts.get(e["state"], 0) + 1
+    return counts
+
+
+def metrics_endpoint() -> str:
+    """Prometheus scrape address, e.g. "127.0.0.1:9201" (reference: the
+    dashboard agent's metrics exporter)."""
+    ep = _gcs().call_retrying("GetMetricsEndpoint")
+    return f"{ep['host']}:{ep['port']}"
+
+
+def get_logs(after_seq: int = 0, limit: int = 1000) -> Dict[str, Any]:
+    """Buffered worker log lines: (seq, node_id, worker_id, line)."""
+    return _gcs().call_retrying("GetLogs", after_seq=after_seq, limit=limit)
+
+
 def cluster_summary() -> Dict[str, Any]:
     """Aggregate view (reference: `ray status` output / state summary)."""
     core = worker_mod._require_connected().core
@@ -55,4 +81,5 @@ def cluster_summary() -> Dict[str, Any]:
         "available_resources": core.available_resources(),
         "actors": len(list_actors()),
         "placement_groups": len(list_placement_groups()),
+        "tasks": task_summary(),
     }

@@ -1,0 +1,91 @@
+"""Observability: metrics API + Prometheus endpoint, task events in the
+state API, worker-log forwarding (reference: util/metrics.py,
+stats/metric.h:104, GcsTaskManager, _private/log_monitor.py)."""
+
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import metrics as rmetrics
+from ray_tpu.util import state as rstate
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_task_events_in_state_api(cluster):
+    @ray_tpu.remote
+    def work(x):
+        return x + 1
+
+    assert ray_tpu.get([work.remote(i) for i in range(5)], timeout=60) == list(range(1, 6))
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        events = rstate.list_tasks()
+        finished = [e for e in events if e["state"] == "FINISHED" and e["name"].endswith("work")]
+        if len(finished) >= 5:
+            break
+        time.sleep(0.5)
+    assert len(finished) >= 5
+    summary = rstate.task_summary()
+    assert summary.get("SUBMITTED", 0) >= 5 and summary.get("FINISHED", 0) >= 5
+
+
+def test_metrics_prometheus_scrape(cluster):
+    c = rmetrics.Counter("bench_requests_total", description="reqs", tag_keys=("kind",))
+    g = rmetrics.Gauge("bench_inflight")
+    h = rmetrics.Histogram("bench_latency_s", boundaries=[0.01, 0.1, 1.0])
+    for _ in range(7):
+        c.inc(1, tags={"kind": "a"})
+    g.set(3.5)
+    h.observe(0.05)
+    h.observe(0.5)
+
+    # metrics also flow from worker processes
+    @ray_tpu.remote
+    def worker_metric():
+        from ray_tpu.util import metrics as m
+
+        cc = m.Counter("bench_worker_total")
+        cc.inc(2)
+        time.sleep(3)  # let the pusher fire
+        return 1
+
+    ref = worker_metric.remote()
+    endpoint = rstate.metrics_endpoint()
+    deadline = time.monotonic() + 30
+    text = ""
+    while time.monotonic() < deadline:
+        text = urllib.request.urlopen(f"http://{endpoint}/metrics", timeout=10).read().decode()
+        if "bench_requests_total" in text and "bench_worker_total" in text:
+            break
+        time.sleep(1.0)
+    ray_tpu.get(ref, timeout=60)
+    assert 'bench_requests_total{kind="a"} 7' in text
+    assert "bench_inflight 3.5" in text
+    assert "bench_latency_s_count 2" in text
+    assert "bench_worker_total 2" in text
+    assert "ray_tpu_nodes_alive 1" in text
+
+
+def test_worker_logs_forwarded(cluster):
+    @ray_tpu.remote
+    def noisy():
+        print("hello-from-worker-stdout")
+        return 1
+
+    assert ray_tpu.get(noisy.remote(), timeout=60) == 1
+    deadline = time.monotonic() + 20
+    found = False
+    while time.monotonic() < deadline and not found:
+        lines = rstate.get_logs(limit=5000)["lines"]
+        found = any("hello-from-worker-stdout" in l[3] for l in lines)
+        if not found:
+            time.sleep(0.5)
+    assert found, "worker stdout line never reached the GCS log buffer"
