@@ -349,6 +349,7 @@ class CoreWorker(CoreRuntime):
         # owner RPC server (GetObject / WaitObject / health)
         self.server = RpcServer(name=f"core-{self.worker_id_hex[:8]}")
         self.server.register("GetObject", self._handle_get_object)
+        self.server.register("GetObjectsStatus", self._handle_get_objects_status)
         self.server.register("WaitObject", self._handle_wait_object)
         self.server.register("RecoverObject", self._handle_recover_object)
         self.server.register("AddBorrower", self._handle_add_borrower)
@@ -470,12 +471,20 @@ class CoreWorker(CoreRuntime):
         _private/log_monitor.py tailing worker logs to the driver)."""
         import sys
 
-        seq = 0
+        # start at the CURRENT tail: a fresh driver must not replay the
+        # cluster's whole historical log backlog
+        seq = None
         while not self._shutdown:
             time.sleep(1.0)
             try:
-                reply = self.gcs.call("GetLogs", after_seq=seq, timeout=10)
+                reply = self.gcs.call(
+                    "GetLogs", after_seq=seq or 0, limit=0 if seq is None else 1000,
+                    timeout=10,
+                )
             except Exception:  # noqa: BLE001
+                continue
+            if seq is None:
+                seq = reply.get("latest_seq", 0)
                 continue
             for s, node_id, worker_id, line in reply.get("lines", []):
                 seq = max(seq, s)
@@ -498,6 +507,12 @@ class CoreWorker(CoreRuntime):
         if self._ref_counter().has_reference(oid):
             return {"status": "pending"}
         return {"status": "freed"}
+
+    def _handle_get_objects_status(self, object_id_bins: List[bytes]) -> List[dict]:
+        """Batched GetObject — one RPC covers every ref wait() is watching
+        on this owner (replaces the per-ref polling the round-2 review
+        flagged; reference: pubsub object-location channel)."""
+        return [self._handle_get_object(b) for b in object_id_bins]
 
     def _handle_wait_object(self, object_id_bin: bytes, timeout_s: float = 10.0) -> dict:
         oid = ObjectID(object_id_bin)
@@ -686,22 +701,37 @@ class CoreWorker(CoreRuntime):
         # (GIL held by a multi-GB deserialize, host pause) can miss pings:
         # require 3 consecutive failures with generous timeouts (~90s of
         # silence at the default 30s period) before declaring it dead.
+        # Pings run CONCURRENTLY — a serial sweep is O(borrowers × 10s
+        # timeout) on one thread (round-2 review finding).
+        from concurrent.futures import ThreadPoolExecutor
+
         rc = self._ref_counter()
         by_addr = rc.borrower_addrs()
         for addr in list(self._borrower_ping_failures):
             if addr not in by_addr:
                 self._borrower_ping_failures.pop(addr, None)
-        for addr, oids in by_addr.items():
+        if not by_addr:
+            return
+
+        def ping(addr):
             try:
                 get_client(addr).call("Ping", timeout=10)
+                return addr, True
+            except Exception:  # noqa: BLE001
+                return addr, False
+
+        with ThreadPoolExecutor(max_workers=min(16, len(by_addr))) as pool:
+            results = list(pool.map(ping, by_addr))
+        for addr, alive in results:
+            if alive:
                 self._borrower_ping_failures.pop(addr, None)
-            except Exception:
-                n = self._borrower_ping_failures.get(addr, 0) + 1
-                self._borrower_ping_failures[addr] = n
-                if n >= 3:
-                    self._borrower_ping_failures.pop(addr, None)
-                    for oid in oids:
-                        rc.remove_borrower(oid, addr)
+                continue
+            n = self._borrower_ping_failures.get(addr, 0) + 1
+            self._borrower_ping_failures[addr] = n
+            if n >= 3:
+                self._borrower_ping_failures.pop(addr, None)
+                for oid in by_addr[addr]:
+                    rc.remove_borrower(oid, addr)
 
     def _on_borrow_released(self, oid: ObjectID) -> None:
         """Last local ObjectRef for a borrowed oid died → drop the claim's
@@ -1048,22 +1078,26 @@ class CoreWorker(CoreRuntime):
         ready: List[ObjectRef] = []
         while True:
             still: List[ObjectRef] = []
+            by_owner: Dict[Tuple[str, int], List[ObjectRef]] = {}
             for r in pending:
                 if self.memory_store.contains(r.id()) or self.plasma.contains(r.id()):
                     ready.append(r)
                 elif not self._ref_counter().is_owned(r.id()) and r.owner_address:
-                    try:
-                        reply = get_client(tuple(r.owner_address)).call(
-                            "GetObject", object_id_bin=r.id().binary(), timeout=5
-                        )
-                        if reply["status"] != "pending":
-                            ready.append(r)
-                        else:
-                            still.append(r)
-                    except Exception:
-                        still.append(r)
+                    by_owner.setdefault(tuple(r.owner_address), []).append(r)
                 else:
                     still.append(r)
+            # one batched status RPC per owner per round (not per ref)
+            for owner, owner_refs in by_owner.items():
+                try:
+                    replies = get_client(owner).call(
+                        "GetObjectsStatus",
+                        object_id_bins=[r.id().binary() for r in owner_refs],
+                        timeout=5,
+                    )
+                    for r, reply in zip(owner_refs, replies):
+                        (ready if reply["status"] != "pending" else still).append(r)
+                except Exception:  # noqa: BLE001
+                    still.extend(owner_refs)
             pending = still
             if len(ready) >= num_returns or not pending:
                 break
@@ -1344,6 +1378,17 @@ class CoreWorker(CoreRuntime):
         return get_client(tuple(entry.raylet_addr))
 
     async def _push_task(self, spec: TaskSpec, entry: _LeaseEntry) -> None:
+        st = self._pending_tasks.get(spec.task_id)
+        if st is not None:
+            if st.get("cancelled"):
+                # cancelled while queued: don't dispatch; returns already
+                # poisoned with TaskCancelledError
+                self._release_task_refs(spec)
+                self._pending_tasks.pop(spec.task_id, None)
+                entry.busy = False
+                await self._on_lease_idle(spec.scheduling_class, entry)
+                return
+            st["entry"] = entry  # cancel() needs the executing worker
         client = get_client(entry.worker_addr)
         try:
             reply = await client.acall(
@@ -1432,7 +1477,7 @@ class CoreWorker(CoreRuntime):
         except Exception:
             pass
         st = self._pending_tasks.get(spec.task_id)
-        if st is not None and st["retries_left"] > 0:
+        if st is not None and st["retries_left"] > 0 and not st.get("cancelled"):
             st["retries_left"] -= 1
             spec.attempt_number += 1
             logger.info("retrying task %s (%d left)", spec.task_id.hex()[:12], st["retries_left"])
@@ -1449,9 +1494,10 @@ class CoreWorker(CoreRuntime):
             for oid in spec.return_ids():
                 self.memory_store.put(oid, ("inline", data))
             self._release_task_refs(spec)
-            self._pending_tasks.pop(spec.task_id, None)
-            self._record_task_event(
-                spec.task_id, spec.function_descriptor.repr_name, "FAILED")
+            st0 = self._pending_tasks.pop(spec.task_id, None)
+            if not (st0 or {}).get("cancelled"):
+                self._record_task_event(
+                    spec.task_id, spec.function_descriptor.repr_name, "FAILED")
 
     def _complete_task(self, spec: TaskSpec, reply: dict) -> None:
         if spec.is_streaming_generator:
@@ -1463,10 +1509,11 @@ class CoreWorker(CoreRuntime):
                 error=reply.get("stream_error"),
             )
             self._release_task_refs(spec)
-            self._pending_tasks.pop(spec.task_id, None)
-            self._record_task_event(
-                spec.task_id, spec.function_descriptor.repr_name,
-                "FAILED" if reply.get("stream_error") else "FINISHED")
+            st0 = self._pending_tasks.pop(spec.task_id, None)
+            if not (st0 or {}).get("cancelled"):  # cancel() already logged
+                self._record_task_event(
+                    spec.task_id, spec.function_descriptor.repr_name,
+                    "FAILED" if reply.get("stream_error") else "FINISHED")
             return
         returns = reply.get("returns", [])
         retriable_error = reply.get("retriable_error")
@@ -1476,7 +1523,7 @@ class CoreWorker(CoreRuntime):
             self._absorb_dropped_handoffs({"dropped_borrows": reply["dropped_borrows"]})
         if retriable_error and spec.retry_exceptions:
             st = self._pending_tasks.get(spec.task_id)
-            if st is not None and st["retries_left"] > 0:
+            if st is not None and st["retries_left"] > 0 and not st.get("cancelled"):
                 st["retries_left"] -= 1
                 spec.attempt_number += 1
                 self._absorb_dropped_handoffs({"returns": returns})
@@ -1522,12 +1569,13 @@ class CoreWorker(CoreRuntime):
                     self._evict_lineage(oid)
         else:
             self._release_task_refs(spec)
-        self._pending_tasks.pop(spec.task_id, None)
-        # the worker sets retriable_error on ANY application exception; if
-        # it survives to here the retries are exhausted -> FAILED
-        self._record_task_event(
-            spec.task_id, spec.function_descriptor.repr_name,
-            "FAILED" if retriable_error else "FINISHED")
+        st0 = self._pending_tasks.pop(spec.task_id, None)
+        if not (st0 or {}).get("cancelled"):  # cancel() already logged
+            # the worker sets retriable_error on ANY application exception;
+            # if it survives to here the retries are exhausted -> FAILED
+            self._record_task_event(
+                spec.task_id, spec.function_descriptor.repr_name,
+                "FAILED" if retriable_error else "FINISHED")
 
     # ==================================================================
     # Object recovery (reference: object_recovery_manager.h:41 — the owner
@@ -1935,14 +1983,29 @@ class CoreWorker(CoreRuntime):
         return ActorID.from_hex(aid)
 
     def cancel(self, ref: ObjectRef, force: bool = False, recursive: bool = True) -> None:
-        # round-1: best effort — mark so queued (not yet pushed) tasks fail.
+        """Cancel the task that creates ``ref`` (reference: CancelTask,
+        core_worker.cc). Queued tasks are dropped before dispatch; RUNNING
+        tasks get TaskCancelledError raised in their executing thread
+        (force=True kills the worker process instead)."""
         tid = ref.id().task_id()
         st = self._pending_tasks.get(tid)
-        if st is not None:
-            err = serialize(TaskCancelledError(f"Task {tid.hex()[:12]} cancelled"))
-            for oid in st["spec"].return_ids():
-                if not self.memory_store.contains(oid):
-                    self.memory_store.put(oid, ("inline", err))
+        if st is None:
+            return
+        st["cancelled"] = True  # blocks dispatch-from-queue and retries
+        err = serialize(TaskCancelledError(f"Task {tid.hex()[:12]} cancelled"))
+        for oid in st["spec"].return_ids():
+            if not self.memory_store.contains(oid):
+                self.memory_store.put(oid, ("inline", err))
+        entry = st.get("entry")
+        if entry is not None:  # already pushed to a worker
+            try:
+                get_client(entry.worker_addr).call(
+                    "CancelTask", task_id_bin=tid.binary(), force=force, timeout=10
+                )
+            except Exception:  # noqa: BLE001
+                pass
+        self._record_task_event(
+            tid, st["spec"].function_descriptor.repr_name, "FAILED")
 
     # ==================================================================
     # Placement groups

@@ -103,6 +103,12 @@ class GcsServer:
         self.log_buffer: Any = deque(maxlen=50000)
         self._log_seq = 0
         self.metrics_http_port = 0
+        # pubsub (reference: src/ray/pubsub/publisher.h:357 — long-poll
+        # publisher with per-channel cursors): channel -> deque of
+        # (seq, key, payload); subscribers long-poll past their cursor
+        self.pubsub: Dict[str, Any] = {}
+        self._pubsub_seq = 0
+        self._pubsub_waiters: Any = None  # asyncio.Condition, lazy
         self._load_persisted()
         self.server.register_instance(self)
 
@@ -234,6 +240,9 @@ class GcsServer:
                     logger.warning("node %s missed heartbeats; marking dead", node.node_id[:12])
                     node.alive = False
                     self._node_version += 1
+                    self._publish_and_wake(
+                        "node_state", node.node_id, {"alive": False}
+                    )
                     await self._on_node_death(node.node_id)
 
     async def _on_node_death(self, node_id: str) -> None:
@@ -468,6 +477,11 @@ class GcsServer:
         if evt is not None:
             evt.set()
             self._actor_events[actor_id] = asyncio.Event()
+        a = self.actors.get(actor_id)
+        self._publish_and_wake(
+            "actor_state", actor_id,
+            {"state": a.state, "version": a.version} if a else None,
+        )
 
     async def GetActorInfo(self, actor_id: str) -> Optional[dict]:
         a = self.actors.get(actor_id)
@@ -735,7 +749,15 @@ class GcsServer:
     # GcsTaskManager task-event history, _private/log_monitor.py)
     # ------------------------------------------------------------------
     async def ReportMetrics(self, producer: str, metrics: List[dict]) -> dict:
-        self.metrics_by_producer[producer] = (metrics, time.monotonic())
+        now = time.monotonic()
+        self.metrics_by_producer[producer] = (metrics, now)
+        # evict dead producers here too (not only at scrape time) so the
+        # table stays bounded on clusters nobody scrapes
+        if len(self.metrics_by_producer) % 16 == 0:
+            self.metrics_by_producer = {
+                p: (m, ts) for p, (m, ts) in self.metrics_by_producer.items()
+                if now - ts < 30.0
+            }
         return {"ok": True}
 
     async def ReportTaskEvents(self, events: List[dict]) -> dict:
@@ -763,10 +785,68 @@ class GcsServer:
         deliberate simplification here)."""
         lines = [e for e in self.log_buffer if e[0] > after_seq][:limit]
         next_seq = lines[-1][0] if lines else after_seq
-        return {"lines": lines, "next_seq": next_seq}
+        return {"lines": lines, "next_seq": next_seq, "latest_seq": self._log_seq}
 
     async def GetMetricsEndpoint(self) -> dict:
         return {"host": "127.0.0.1", "port": self.metrics_http_port}
+
+    # ------------------------------------------------------------------
+    # Pubsub (reference: src/ray/pubsub/ — long-poll Publisher
+    # publisher.h:357 / Subscriber subscriber.h:215). Channels carry
+    # actor-state and node-state changes plus user events; this replaces
+    # per-entity polling on the subscriber side.
+    # ------------------------------------------------------------------
+    def _pubsub_cv(self):
+        import asyncio as _a
+
+        if self._pubsub_waiters is None:
+            self._pubsub_waiters = _a.Condition()
+        return self._pubsub_waiters
+
+    async def Publish(self, channel: str, key: str, payload: Any = None) -> dict:
+        self._publish(channel, key, payload)
+        cv = self._pubsub_cv()
+        async with cv:
+            cv.notify_all()
+        return {"seq": self._pubsub_seq}
+
+    def _publish(self, channel: str, key: str, payload: Any = None) -> None:
+        from collections import deque as _dq
+
+        self._pubsub_seq += 1
+        self.pubsub.setdefault(channel, _dq(maxlen=10000)).append(
+            (self._pubsub_seq, key, payload)
+        )
+
+    def _publish_and_wake(self, channel: str, key: str, payload: Any = None) -> None:
+        self._publish(channel, key, payload)
+        cv = self._pubsub_waiters
+        if cv is not None:
+            async def _wake():
+                async with cv:
+                    cv.notify_all()
+
+            asyncio.ensure_future(_wake())
+
+    async def Subscribe(self, channel: str, after_seq: int = 0,
+                        timeout_s: float = 20.0) -> dict:
+        """Long-poll: return events with seq > after_seq; block until one
+        arrives or the timeout lapses."""
+        deadline = time.monotonic() + timeout_s
+        cv = self._pubsub_cv()
+        while True:
+            q = self.pubsub.get(channel)
+            events = [e for e in (q or ()) if e[0] > after_seq]
+            if events:
+                return {"events": events, "next_seq": events[-1][0]}
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return {"events": [], "next_seq": after_seq}
+            async with cv:
+                try:
+                    await asyncio.wait_for(cv.wait(), timeout=remaining)
+                except asyncio.TimeoutError:
+                    pass
 
     def _prometheus_text(self) -> str:
         """Aggregated user metrics + built-in cluster gauges, Prometheus

@@ -937,11 +937,15 @@ class Raylet:
                             data = f.read(64 * 1024)
                         # only consume complete lines: a partial trailing
                         # line (mid-write, or chunk-cap split) stays for
-                        # the next cycle
+                        # the next cycle — but a single line LONGER than
+                        # the chunk must be consumed anyway or the tailer
+                        # wedges on it forever
                         cut = data.rfind(b"\n")
                         if cut < 0:
-                            continue
-                        data = data[: cut + 1]
+                            if len(data) < 64 * 1024:
+                                continue  # partial line, retry next cycle
+                        else:
+                            data = data[: cut + 1]
                         offsets[fname] = off + len(data)
                         lines = data.decode(errors="replace").splitlines()
                         if lines:

@@ -376,7 +376,13 @@ class WorkerServer:
         self.actors: Dict[str, _ActorRunner] = {}
         self._task_pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="exec")
         self._function_cache: Dict[bytes, Any] = {}
+        # task_id bin -> executing thread ident, for CancelTask; the lock
+        # makes register/raise/unregister mutually exclusive so a cancel
+        # cannot target a thread that already moved on to another task
+        self._running_tasks: Dict[bytes, int] = {}
+        self._cancel_lock = threading.Lock()
         core.server.register("PushTask", self.PushTask)
+        core.server.register("CancelTask", self.CancelTask)
         core.server.register("CreateActor", self.CreateActor)
         core.server.register("PushActorTask", self.PushActorTask)
         core.server.register("QueryActorTaskResult", self.QueryActorTaskResult)
@@ -445,18 +451,57 @@ class WorkerServer:
                 tuple(caller_addr),
             )
             return fut.result()
-        fut = self._task_pool.submit(
-            _execute_callable,
-            lambda args, kwargs: fn(*args, **kwargs),
-            spec_payload["args"],
-            spec_payload["kwargs"],
-            spec_payload["num_returns"],
-            TaskID(spec_payload["task_id"]),
-            spec_payload["function_name"],
-            None,
-            tuple(caller_addr) if caller_addr else None,
-        )
-        return fut.result()
+        task_bin = spec_payload["task_id"]
+
+        def _runner():
+            with self._cancel_lock:
+                self._running_tasks[task_bin] = threading.get_ident()
+            try:
+                return _execute_callable(
+                    lambda args, kwargs: fn(*args, **kwargs),
+                    spec_payload["args"],
+                    spec_payload["kwargs"],
+                    spec_payload["num_returns"],
+                    TaskID(task_bin),
+                    spec_payload["function_name"],
+                    None,
+                    tuple(caller_addr) if caller_addr else None,
+                )
+            finally:
+                with self._cancel_lock:
+                    self._running_tasks.pop(task_bin, None)
+
+        return self._task_pool.submit(_runner).result()
+
+    def CancelTask(self, task_id_bin: bytes, force: bool = False) -> dict:
+        """Interrupt a RUNNING task (reference: CoreWorker::HandleCancelTask,
+        core_worker.cc CancelTask). Non-force raises TaskCancelledError in
+        the executing thread at its next bytecode boundary; force kills the
+        worker process.
+
+        The register/raise/unregister critical sections share _cancel_lock,
+        so the raise only targets a thread still registered for THIS task.
+        (As in the reference's Python-level cancel, delivery is
+        asynchronous: a task finishing in the same instant can see the
+        exception surface in its packaging code — the caller discards that
+        reply since its returns are already poisoned.)"""
+        from ray_tpu.exceptions import TaskCancelledError
+
+        if force:
+            threading.Timer(0.05, lambda: os._exit(1)).start()
+            return {"ok": True, "forced": True}
+        import ctypes
+
+        with self._cancel_lock:
+            ident = self._running_tasks.get(bytes(task_id_bin))
+            if ident is None:
+                return {"ok": False, "running": False}
+            n = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(ident), ctypes.py_object(TaskCancelledError)
+            )
+            if n > 1:  # hit more than one thread: undo
+                ctypes.pythonapi.PyThreadState_SetAsyncExc(ctypes.c_ulong(ident), None)
+        return {"ok": n == 1}
 
     # -- actors ---------------------------------------------------------
     def CreateActor(self, actor_id: str, serialized_spec: bytes) -> dict:

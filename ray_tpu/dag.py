@@ -1,10 +1,10 @@
 """Lazy task/actor DAG building + execution.
 
 Reference: python/ray/dag/ (DAGNode, FunctionNode, ClassNode, InputNode;
-compiled DAGs live in compiled_dag_node.py). Round-1 scope: build/execute
-uncompiled DAGs — ``f.bind(x).execute()`` submits the underlying tasks with
-dependencies expressed as ObjectRefs. Compiled (pre-allocated channel)
-execution is layered on later (see ray_tpu/experimental/channel planned work).
+compiled DAGs in compiled_dag_node.py). ``f.bind(x).execute()`` submits the
+underlying tasks with dependencies expressed as ObjectRefs;
+``.experimental_compile()`` returns a CompiledDAG (ray_tpu/dag_compiled.py)
+whose schedule and actors are fixed once and reused across executions.
 """
 
 from __future__ import annotations
@@ -140,4 +140,23 @@ class ActorMethodNode(DAGNode):
         return self._cache
 
 
-MultiOutputNode = list  # reference API compat: wrap terminal nodes in a list
+class MultiOutputNode(DAGNode):
+    """Terminal wrapper returning every member's result
+    (reference: ray.dag.MultiOutputNode)."""
+
+    def __init__(self, nodes):
+        super().__init__(tuple(nodes), {})
+
+    def __iter__(self):
+        return iter(self._bound_args)
+
+    def __len__(self):
+        return len(self._bound_args)
+
+    def _execute_impl(self, input_value):
+        return [n._execute_impl(input_value) for n in self._bound_args]
+
+    def experimental_compile(self, **kwargs):
+        from ray_tpu.dag_compiled import CompiledDAG
+
+        return CompiledDAG(list(self._bound_args), **kwargs)

@@ -1,0 +1,155 @@
+"""Compiled DAG execution (reference: python/ray/dag/compiled_dag_node.py:813
+CompiledDAG).
+
+The reference pre-compiles an actor-task DAG into static shared-memory
+channels plus a per-actor execution schedule, so a steady-state `execute()`
+does no Python-side graph work. The TPU-first reading (SURVEY.md §2.3): the
+*device* side of an aDAG is already compiled by XLA inside each jitted
+actor method; what the framework owns is the host-side schedule. Compiling
+here means:
+
+- the DAG is validated and topologically ordered ONCE,
+- ClassNodes instantiate their actors ONCE (reused across executes),
+- per-node argument wiring is precomputed (which upstream output / which
+  constant feeds each slot), so execute() is a flat loop of task
+  submissions with ObjectRef dependencies — no graph traversal, no
+  node-cache invalidation, no re-pickling of bound constants.
+
+Multiple executions may be in flight concurrently; each returns fresh
+ObjectRefs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.dag import (
+    ActorMethodNode,
+    ClassMethodNode,
+    ClassNode,
+    DAGNode,
+    FunctionNode,
+    InputNode,
+)
+
+
+class _Slot:
+    """Where one argument of a compiled node comes from."""
+
+    __slots__ = ("kind", "value")
+
+    def __init__(self, kind: str, value: Any):
+        self.kind = kind  # "const" | "node" | "input"
+        self.value = value  # constant | node index | None
+
+
+class CompiledDAG:
+    """Host-side compiled schedule for a DAG (reference:
+    compiled_dag_node.py:813)."""
+
+    def __init__(self, root, **_kwargs):
+        self._outputs: List[DAGNode] = list(root) if isinstance(root, list) else [root]
+        self._multi = isinstance(root, list)
+        self._nodes: List[DAGNode] = []
+        self._index: Dict[int, int] = {}  # id(node) -> schedule position
+        self._slots: List[Tuple[List[_Slot], Dict[str, _Slot]]] = []
+        self._handles: Dict[int, Any] = {}  # schedule pos of ClassNode -> actor
+        self._torn_down = False
+        for out in self._outputs:
+            self._visit(out)
+        self._compile()
+
+    # -- compile --------------------------------------------------------
+    def _visit(self, node: DAGNode) -> int:
+        if id(node) in self._index:
+            return self._index[id(node)]
+        if isinstance(node, ClassMethodNode):
+            self._visit(node._class_node)
+        for v in list(node._bound_args) + list(node._bound_kwargs.values()):
+            if isinstance(v, DAGNode):
+                self._visit(v)
+        pos = len(self._nodes)
+        self._index[id(node)] = pos
+        self._nodes.append(node)
+        return pos
+
+    def _slot(self, v) -> _Slot:
+        if isinstance(v, InputNode):
+            return _Slot("input", None)
+        if isinstance(v, DAGNode):
+            return _Slot("node", self._index[id(v)])
+        return _Slot("const", v)
+
+    def _compile(self) -> None:
+        n_inputs = sum(1 for n in self._nodes if isinstance(n, InputNode))
+        if n_inputs > 1:
+            raise ValueError("compiled DAGs support at most one InputNode")
+        for node in self._nodes:
+            args = [self._slot(a) for a in node._bound_args]
+            kwargs = {k: self._slot(v) for k, v in node._bound_kwargs.items()}
+            self._slots.append((args, kwargs))
+            if isinstance(node, ClassNode):
+                # actors are part of the compiled graph: created once here
+                pos = self._index[id(node)]
+                cargs = [s.value for s in args]
+                if any(s.kind != "const" for s in args) or any(
+                    s.kind != "const" for s in kwargs.values()
+                ):
+                    raise ValueError(
+                        "compiled ClassNode constructor args must be constants"
+                    )
+                self._handles[pos] = node._actor_cls._remote(
+                    tuple(cargs), {k: s.value for k, s in kwargs.items()},
+                    node._options,
+                )
+
+    # -- execute --------------------------------------------------------
+    def execute(self, *input_values):
+        if self._torn_down:
+            raise RuntimeError("CompiledDAG was torn down")
+        input_value = input_values[0] if input_values else None
+        results: List[Any] = [None] * len(self._nodes)
+
+        def resolve(slot: _Slot):
+            if slot.kind == "const":
+                return slot.value
+            if slot.kind == "input":
+                return input_value
+            return results[slot.value]
+
+        for pos, node in enumerate(self._nodes):
+            arg_slots, kwarg_slots = self._slots[pos]
+            if isinstance(node, InputNode):
+                results[pos] = input_value
+                continue
+            if isinstance(node, ClassNode):
+                results[pos] = self._handles[pos]
+                continue
+            args = tuple(resolve(s) for s in arg_slots)
+            kwargs = {k: resolve(s) for k, s in kwarg_slots.items()}
+            if isinstance(node, FunctionNode):
+                results[pos] = node._remote_fn._remote(args, kwargs, node._options)
+            elif isinstance(node, ClassMethodNode):
+                handle = self._handles[self._index[id(node._class_node)]]
+                results[pos] = handle._actor_method_call(node._method_name, args, kwargs)
+            elif isinstance(node, ActorMethodNode):
+                results[pos] = node._handle._actor_method_call(
+                    node._method_name, args, kwargs
+                )
+            else:
+                raise TypeError(f"cannot compile node type {type(node).__name__}")
+        outs = [results[self._index[id(o)]] for o in self._outputs]
+        return outs if self._multi else outs[0]
+
+    def teardown(self) -> None:
+        """Kill actors this compiled DAG created (reference:
+        CompiledDAG.teardown)."""
+        import ray_tpu
+
+        self._torn_down = True
+        for handle in self._handles.values():
+            try:
+                ray_tpu.kill(handle)
+            except Exception:  # noqa: BLE001
+                pass
+        self._handles.clear()

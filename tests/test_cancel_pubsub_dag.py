@@ -1,0 +1,160 @@
+"""Round-1 debt closures: compiled DAGs (dag_compiled.py), real task
+cancellation (CancelTask), and GCS pubsub (publisher.h:357)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode, MultiOutputNode
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=3, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# compiled DAG
+# ---------------------------------------------------------------------------
+def test_compiled_dag_function_chain(cluster):
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    with InputNode() as inp:
+        dag = inc.bind(double.bind(inp))
+    compiled = dag.experimental_compile()
+    assert ray_tpu.get(compiled.execute(5), timeout=60) == 11
+    assert ray_tpu.get(compiled.execute(10), timeout=60) == 21  # reusable
+
+
+def test_compiled_dag_actor_reuse_and_teardown(cluster):
+    @ray_tpu.remote
+    class Accum:
+        def __init__(self):
+            self.total = 0
+
+        def add(self, x):
+            self.total += x
+            return self.total
+
+    with InputNode() as inp:
+        dag = Accum.bind().add.bind(inp)
+    compiled = dag.experimental_compile()
+    # the SAME actor instance serves every execute (state accumulates)
+    assert ray_tpu.get(compiled.execute(3), timeout=60) == 3
+    assert ray_tpu.get(compiled.execute(4), timeout=60) == 7
+    compiled.teardown()
+    with pytest.raises(RuntimeError):
+        compiled.execute(1)
+
+
+def test_compiled_dag_multi_output(cluster):
+    @ray_tpu.remote
+    def plus(x, y):
+        return x + y
+
+    @ray_tpu.remote
+    def times(x, y):
+        return x * y
+
+    with InputNode() as inp:
+        dag = MultiOutputNode([plus.bind(inp, 10), times.bind(inp, 10)])
+    compiled = dag.experimental_compile()
+    assert ray_tpu.get(compiled.execute(4), timeout=60) == [14, 40]
+
+
+# ---------------------------------------------------------------------------
+# cancel
+# ---------------------------------------------------------------------------
+def test_cancel_running_task(cluster):
+    @ray_tpu.remote
+    def spin(sec):
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < sec:
+            time.sleep(0.05)  # cooperative: async-exc lands between sleeps
+        return "finished"
+
+    ref = spin.remote(30)
+    time.sleep(2.0)  # let it start
+    t0 = time.monotonic()
+    ray_tpu.cancel(ref)
+    with pytest.raises(ray_tpu.exceptions.TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    assert time.monotonic() - t0 < 15  # didn't wait the full 30s
+
+
+def test_cancel_queued_task(cluster):
+    @ray_tpu.remote
+    def blocker():
+        time.sleep(5)
+        return 1
+
+    @ray_tpu.remote
+    def queued():
+        return 2
+
+    blockers = [blocker.remote() for _ in range(3)]  # saturate 3 CPUs
+    victim = queued.remote()
+    ray_tpu.cancel(victim)
+    with pytest.raises(ray_tpu.exceptions.TaskCancelledError):
+        ray_tpu.get(victim, timeout=30)
+    assert ray_tpu.get(blockers, timeout=60) == [1, 1, 1]
+
+
+# ---------------------------------------------------------------------------
+# pubsub
+# ---------------------------------------------------------------------------
+def test_pubsub_publish_subscribe(cluster):
+    from ray_tpu._private import worker as worker_mod
+
+    gcs = worker_mod.global_worker.core.gcs
+    gcs.call("Publish", channel="test_chan", key="k1", payload={"v": 1}, timeout=10)
+    reply = gcs.call("Subscribe", channel="test_chan", after_seq=0, timeout_s=5.0, timeout=20)
+    assert reply["events"] and reply["events"][-1][1] == "k1"
+    cursor = reply["next_seq"]
+    # long-poll wakes on a new publish
+    import threading
+
+    def publish_later():
+        time.sleep(0.5)
+        gcs.call("Publish", channel="test_chan", key="k2", payload=None, timeout=10)
+
+    threading.Thread(target=publish_later, daemon=True).start()
+    t0 = time.monotonic()
+    reply = gcs.call("Subscribe", channel="test_chan", after_seq=cursor, timeout_s=10.0, timeout=30)
+    assert reply["events"][0][1] == "k2"
+    assert 0.3 < time.monotonic() - t0 < 5.0  # woke on publish, not timeout
+
+
+def test_pubsub_actor_state_events(cluster):
+    from ray_tpu._private import worker as worker_mod
+
+    gcs = worker_mod.global_worker.core.gcs
+
+    @ray_tpu.remote
+    class Ephemeral:
+        def ping(self):
+            return 1
+
+    a = Ephemeral.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == 1
+    ray_tpu.kill(a)
+    deadline = time.monotonic() + 15
+    states = []
+    cursor = 0
+    while time.monotonic() < deadline:
+        reply = gcs.call("Subscribe", channel="actor_state", after_seq=cursor,
+                         timeout_s=2.0, timeout=20)
+        cursor = reply["next_seq"]
+        states.extend(p["state"] for _s, _k, p in reply["events"] if p)
+        if "DEAD" in states:
+            break
+    assert "ALIVE" in states and "DEAD" in states
