@@ -1389,6 +1389,15 @@ class CoreWorker(CoreRuntime):
                 await self._on_lease_idle(spec.scheduling_class, entry)
                 return
             st["entry"] = entry  # cancel() needs the executing worker
+            if st.get("cancelled"):
+                # cancel() ran between the check above and the entry
+                # assignment — it saw entry=None and skipped the CancelTask
+                # RPC, so don't dispatch (returns are already poisoned)
+                self._release_task_refs(spec)
+                self._pending_tasks.pop(spec.task_id, None)
+                entry.busy = False
+                await self._on_lease_idle(spec.scheduling_class, entry)
+                return
         client = get_client(entry.worker_addr)
         try:
             reply = await client.acall(
@@ -1517,6 +1526,20 @@ class CoreWorker(CoreRuntime):
             return
         returns = reply.get("returns", [])
         retriable_error = reply.get("retriable_error")
+        st_pre = self._pending_tasks.get(spec.task_id)
+        if st_pre is not None and st_pre.get("cancelled"):
+            # the CancelTask raced with completion and lost: keep the
+            # TaskCancelledError poison in the return objects, discard the
+            # late reply (and its plasma copies, or they leak)
+            self._absorb_dropped_handoffs({"returns": returns})
+            for i, ret in enumerate(returns):
+                if ret.get("kind") != "inline":
+                    oid = ObjectID.from_index(spec.task_id, i + 1)
+                    self._delete_plasma_copy(
+                        oid, ret.get("node_id", self.node_id))
+            self._release_task_refs(spec)
+            self._pending_tasks.pop(spec.task_id, None)
+            return
         if reply.get("dropped_borrows"):
             # borrows registered for values that failed to package — the
             # error reply supersedes them (advisor/review finding, round 2)

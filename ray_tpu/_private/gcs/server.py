@@ -834,15 +834,18 @@ class GcsServer:
         arrives or the timeout lapses."""
         deadline = time.monotonic() + timeout_s
         cv = self._pubsub_cv()
-        while True:
-            q = self.pubsub.get(channel)
-            events = [e for e in (q or ()) if e[0] > after_seq]
-            if events:
-                return {"events": events, "next_seq": events[-1][0]}
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                return {"events": [], "next_seq": after_seq}
-            async with cv:
+        # Predicate check and wait both under the condition lock — a
+        # publish firing between an unlocked check and cv.wait() would
+        # otherwise be a lost wakeup (delivery delayed a full timeout).
+        async with cv:
+            while True:
+                q = self.pubsub.get(channel)
+                events = [e for e in (q or ()) if e[0] > after_seq]
+                if events:
+                    return {"events": events, "next_seq": events[-1][0]}
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return {"events": [], "next_seq": after_seq}
                 try:
                     await asyncio.wait_for(cv.wait(), timeout=remaining)
                 except asyncio.TimeoutError:

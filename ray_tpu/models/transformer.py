@@ -357,11 +357,15 @@ def forward(cfg: TransformerConfig, params: Params, tokens: jax.Array,
 
     blocks, lora = params["blocks"], params.get("lora")
 
-    def body(x, layer):
-        lp = layer["p"]
-        lo = layer.get("l")
-        out = _block(cfg, x, lp, lo, positions, attn_fn)
-        return out, None
+    def body_at(pos):
+        def body(x, layer):
+            lp = layer["p"]
+            lo = layer.get("l")
+            out = _block(cfg, x, lp, lo, pos, attn_fn)
+            return out, None
+        return body
+
+    body = body_at(positions)
 
     layer_tree = {"p": blocks}
     if lora is not None:
@@ -377,13 +381,17 @@ def forward(cfg: TransformerConfig, params: Params, tokens: jax.Array,
     if n_stage > 1:
         from ray_tpu.ops.pipeline import pipelined_layers
 
-        def apply_stage(layers_local, h):
-            h, _ = lax.scan(_remat(body), h, layers_local)
+        n_seq = mesh.shape.get("sequence", 1)
+        seq_axis = "sequence" if n_seq > 1 else None
+
+        def apply_stage(layers_local, h, pos_local):
+            h, _ = lax.scan(_remat(body_at(pos_local)), h, layers_local)
             return h
 
         x = pipelined_layers(
-            mesh, apply_stage, layer_tree, x,
+            mesh, apply_stage, layer_tree, x, positions,
             num_microbatches or 2 * n_stage,
+            seq_axis=seq_axis,
         )
     else:
         x, _ = lax.scan(_remat(body), x, layer_tree)

@@ -26,12 +26,14 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
 
-def pipeline_spmd(body: Callable, x_mb: jax.Array, axis_name: str = "stage"):
-    """Run `body(x) -> x` (this stage's layers) over microbatched input.
+def pipeline_spmd(body: Callable, x_mb: jax.Array, pos_mb: jax.Array,
+                  axis_name: str = "stage"):
+    """Run `body(x, pos) -> x` (this stage's layers) over microbatched input.
 
     Called INSIDE a shard_map manual over `axis_name`. x_mb [M, mb, ...]
-    is replicated across stages; returns [M, mb, ...] outputs valid on
-    every stage (psum-broadcast from the last stage).
+    and pos_mb [M, ...] (per-microbatch rope positions) are replicated
+    across stages; returns [M, mb, ...] outputs valid on every stage
+    (psum-broadcast from the last stage).
     """
     n_stage = lax.axis_size(axis_name)
     stage = lax.axis_index(axis_name)
@@ -46,7 +48,10 @@ def pipeline_spmd(body: Callable, x_mb: jax.Array, axis_name: str = "stage"):
         inp = lax.dynamic_index_in_dim(x_mb, jnp.clip(i, 0, M - 1), 0,
                                        keepdims=False)
         cur = jnp.where(stage == 0, inp, recv)
-        out = body(cur)
+        # stage s processes microbatch i - s at iteration i
+        mb_idx = jnp.clip(i - stage, 0, M - 1)
+        pos_cur = lax.dynamic_index_in_dim(pos_mb, mb_idx, 0, keepdims=False)
+        out = body(cur, pos_cur)
         # last stage stores finished microbatch i-(S-1)
         idx_out = jnp.clip(i - (n_stage - 1), 0, M - 1)
         valid = (stage == n_stage - 1) & (i >= n_stage - 1)
@@ -69,16 +74,22 @@ def pipeline_spmd(body: Callable, x_mb: jax.Array, axis_name: str = "stage"):
 
 def pipelined_layers(
     mesh,
-    apply_stage: Callable,  # (stage_local_layer_params, x) -> x
+    apply_stage: Callable,  # (stage_local_layer_params, x, positions) -> x
     stacked_params,         # pytree, leading dim = layers (shards over stage)
     x: jax.Array,           # [B, S, H] activations
+    positions: jax.Array,   # [S] or [B, S] rope positions
     num_microbatches: int,
     axis_name: str = "stage",
+    seq_axis: str = None,   # sequence-parallel mesh axis, if SP is active
 ):
     """Apply layer stack under pipeline parallelism.
 
-    Only `axis_name` goes manual; remaining mesh axes stay automatic so
-    the stage body's einsums keep their GSPMD TP/FSDP partitioning."""
+    `axis_name` (and, when SP composes with PP, `seq_axis`) go manual;
+    remaining mesh axes stay automatic so the stage body's einsums keep
+    their GSPMD TP/FSDP partitioning. Shardy can't nest manual regions
+    that re-bind an ancestor axis, so PP×SP is ONE region manual over
+    both axes — the stage body then calls ring_attention directly with
+    axis_name="sequence" instead of wrapping it in its own shard_map."""
     from jax.sharding import PartitionSpec as P
 
     n_stage = mesh.shape[axis_name]
@@ -90,11 +101,28 @@ def pipelined_layers(
 
     param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
     dtype = x.dtype
+    manual_axes = {axis_name} | ({seq_axis} if seq_axis else set())
+    # [M, mb, S, H]: split S over the sequence axis when SP is on.
+    x_spec = P(None, None, seq_axis) if seq_axis else P()
+    # Positions are microbatched alongside the activations: [S] shared →
+    # [M, S]; per-example [B, S] → [M, mb, S] (pipeline_spmd picks the
+    # slice for the microbatch each stage is processing at each tick).
+    if positions.ndim == 1:
+        pos_mb = jnp.broadcast_to(
+            positions, (num_microbatches,) + positions.shape)
+        pos_spec = P(None, seq_axis) if seq_axis else P()
+    else:
+        if positions.shape[0] != b:
+            raise ValueError(
+                f"positions batch dim {positions.shape[0]} != batch {b}")
+        pos_mb = positions.reshape((num_microbatches, mb) + positions.shape[1:])
+        pos_spec = P(None, None, seq_axis) if seq_axis else P()
 
-    def inner(params_local, x_mb_local):
+    def inner(params_local, x_mb_local, pos_mb_local):
         out = pipeline_spmd(
-            lambda h: apply_stage(params_local, h.astype(dtype)).astype(jnp.float32),
-            x_mb_local, axis_name,
+            lambda h, p_: apply_stage(params_local, h.astype(dtype),
+                                      p_).astype(jnp.float32),
+            x_mb_local, pos_mb_local, axis_name,
         )
         return out
 
@@ -104,9 +132,9 @@ def pipelined_layers(
     out = _shard_map(
         inner,
         mesh=mesh,
-        in_specs=(param_specs, P()),
-        out_specs=P(),
-        axis_names={axis_name},
+        in_specs=(param_specs, x_spec, pos_spec),
+        out_specs=x_spec,
+        axis_names=manual_axes,
         check_vma=False,
-    )(stacked_params, x_mb.astype(jnp.float32))
+    )(stacked_params, x_mb.astype(jnp.float32), pos_mb)
     return out.astype(dtype).reshape((b,) + x.shape[1:])

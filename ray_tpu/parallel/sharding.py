@@ -100,8 +100,24 @@ def constrain(x: jax.Array, logical_axes: Sequence[Optional[str]],
         mesh = jax.sharding.get_abstract_mesh()
         if mesh is None or mesh.empty:  # not under a mesh context
             return x
+        spec = spec_for(logical_axes, rules)
+        # Inside a (partial-)manual shard_map region, constraints may only
+        # reference auto axes — drop mesh axes the context binds as manual.
+        manual = {
+            name for name, ty in zip(mesh.axis_names, mesh.axis_types)
+            if "manual" in str(ty).lower()
+        }
+        if manual:
+            def _keep(entry):
+                if entry is None:
+                    return None
+                if isinstance(entry, tuple):
+                    kept = tuple(a for a in entry if a not in manual)
+                    return kept if len(kept) > 1 else (kept[0] if kept else None)
+                return None if entry in manual else entry
+            spec = P(*[_keep(e) for e in spec])
         return jax.lax.with_sharding_constraint(
-            x, NamedSharding(mesh, spec_for(logical_axes, rules))
+            x, NamedSharding(mesh, spec)
         )
     except Exception:
         return x

@@ -65,21 +65,42 @@ def default_optimizer(cfg: TransformerConfig, lr: float = 3e-4,
 def make_attn_fn(cfg: TransformerConfig, mesh: Mesh,
                  rules: Optional[Rules] = None) -> Optional[Callable]:
     """Ring attention under shard_map when the sequence axis is sharded;
-    None (→ flash/blockwise under pure GSPMD) otherwise."""
+    None (→ flash/blockwise under pure GSPMD) otherwise.
+
+    Partial-manual over ONLY the "sequence" axis: batch/head axes stay
+    GSPMD-automatic, which both keeps TP/DP partitioning on the einsums
+    around attention and lets this region nest inside the pipeline's
+    "stage"-manual shard_map (PP × SP composition — disjoint manual axis
+    sets nest cleanly)."""
     rules = rules or DEFAULT_RULES
     if mesh_axis_size(mesh, "sequence") <= 1:
         return None
-    q_spec = spec_for(("batch", "seq", "heads", "head_dim"), rules, mesh)
-    kv_spec = spec_for(("batch", "seq", "kv_heads", "head_dim"), rules, mesh)
+    if mesh_axis_size(mesh, "stage") > 1:
+        # PP×SP: the pipeline's shard_map is manual over {stage, sequence}
+        # (ops/pipeline.py), so inside it "sequence" is already a bound
+        # axis — call ring_attention directly, no nested shard_map.
+        def attn_manual(q, k, v):
+            k, v = gqa_expand(k, v, q.shape[2])
+            return ring_attention(q, k, v, axis_name="sequence", causal=True)
+
+        return attn_manual
+    seq_spec = P(None, "sequence")  # [B, S, H, D] — split seq dim only
 
     def attn(q, k, v):
         def inner(q, k, v):
-            k, v = gqa_expand(k, v, q.shape[2])  # local head counts
+            k, v = gqa_expand(k, v, q.shape[2])
             return ring_attention(q, k, v, axis_name="sequence", causal=True)
 
+        # When nested inside another (partial-manual) shard_map — e.g. the
+        # pipeline's "stage" region — the inner shard_map must be handed
+        # the context's abstract mesh, whose axis_types already mark the
+        # outer manual axes.
+        ctx_mesh = jax.sharding.get_abstract_mesh()
+        use_mesh = mesh if ctx_mesh is None or ctx_mesh.empty else ctx_mesh
         return _shard_map(
-            inner, mesh=mesh,
-            in_specs=(q_spec, kv_spec, kv_spec), out_specs=q_spec,
+            inner, mesh=use_mesh,
+            in_specs=(seq_spec, seq_spec, seq_spec), out_specs=seq_spec,
+            axis_names={"sequence"},
             check_vma=False,
         )(q, k, v)
 
@@ -155,11 +176,6 @@ def make_train_step(cfg: TransformerConfig, optimizer: optax.GradientTransformat
     rules = _effective_rules(mesh, rules)
     attn = make_attn_fn(cfg, mesh, rules)
     n_stage = mesh_axis_size(mesh, "stage")
-    if n_stage > 1 and mesh_axis_size(mesh, "sequence") > 1:
-        raise NotImplementedError(
-            "stage (pipeline) and sequence (ring attention) parallelism "
-            "cannot be combined yet — nested shard_map regions"
-        )
     pp_mesh = mesh if n_stage > 1 else None
     shardings = state_shardings(cfg, optimizer, mesh, rules)
     b_shard = batch_sharding(mesh, rules)
