@@ -1380,19 +1380,13 @@ class CoreWorker(CoreRuntime):
     async def _push_task(self, spec: TaskSpec, entry: _LeaseEntry) -> None:
         st = self._pending_tasks.get(spec.task_id)
         if st is not None:
-            if st.get("cancelled"):
-                # cancelled while queued: don't dispatch; returns already
-                # poisoned with TaskCancelledError
-                self._release_task_refs(spec)
-                self._pending_tasks.pop(spec.task_id, None)
-                entry.busy = False
-                await self._on_lease_idle(spec.scheduling_class, entry)
-                return
             st["entry"] = entry  # cancel() needs the executing worker
+            # Check AFTER assigning entry: a cancel() that ran earlier (or
+            # concurrently — it sets cancelled before reading entry) is
+            # seen here, so either we skip dispatch or cancel() sends the
+            # CancelTask RPC; the race has no lost interleaving.
             if st.get("cancelled"):
-                # cancel() ran between the check above and the entry
-                # assignment — it saw entry=None and skipped the CancelTask
-                # RPC, so don't dispatch (returns are already poisoned)
+                # don't dispatch; returns already poisoned
                 self._release_task_refs(spec)
                 self._pending_tasks.pop(spec.task_id, None)
                 entry.busy = False
@@ -1532,6 +1526,9 @@ class CoreWorker(CoreRuntime):
             # TaskCancelledError poison in the return objects, discard the
             # late reply (and its plasma copies, or they leak)
             self._absorb_dropped_handoffs({"returns": returns})
+            if reply.get("dropped_borrows"):
+                self._absorb_dropped_handoffs(
+                    {"dropped_borrows": reply["dropped_borrows"]})
             for i, ret in enumerate(returns):
                 if ret.get("kind") != "inline":
                     oid = ObjectID.from_index(spec.task_id, i + 1)

@@ -5,7 +5,13 @@ unchanged); searchers expand grid/random spaces; ASHA/median-stopping
 schedulers stop weak trials early.
 """
 
-from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler, MedianStoppingRule
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    HyperBandScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+)
 from ray_tpu.tune.search import (
     choice,
     grid_search,
@@ -14,12 +20,18 @@ from ray_tpu.tune.search import (
     randint,
     uniform,
 )
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.session import get_checkpoint
 from ray_tpu.tune.tuner import ResultGrid, TrialResult, TuneConfig, Tuner, report
 
 __all__ = [
     "ASHAScheduler",
     "FIFOScheduler",
+    "HyperBandScheduler",
     "MedianStoppingRule",
+    "PopulationBasedTraining",
+    "Checkpoint",
+    "get_checkpoint",
     "ResultGrid",
     "TrialResult",
     "TuneConfig",

@@ -21,7 +21,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu.train.config import RunConfig
-from ray_tpu.tune.schedulers import CONTINUE, FIFOScheduler, STOP
+from ray_tpu.tune.schedulers import CONTINUE, EXPLOIT, FIFOScheduler, STOP
 from ray_tpu.tune.search import generate_variants
 
 
@@ -44,6 +44,8 @@ class TrialResult:
     metrics: Dict[str, Any] = field(default_factory=dict)
     history: List[Dict[str, Any]] = field(default_factory=list)
     error: Optional[str] = None
+    # set when PBT restarted this trial from a donor's checkpoint
+    restart_ckpt: Optional[str] = None
 
     @property
     def done(self) -> bool:
@@ -100,12 +102,18 @@ class _TrialActor:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
-    def start(self, fn_bytes: bytes, config: Dict[str, Any]) -> bool:
+    def start(self, fn_bytes: bytes, config: Dict[str, Any],
+              checkpoint_path: Optional[str] = None) -> bool:
         from ray_tpu._private.serialization import loads_function
         from ray_tpu.train import session as train_session
+        from ray_tpu.train.checkpoint import Checkpoint
 
         fn = loads_function(fn_bytes)
-        ctx = train_session.TrainContext(world_rank=0, world_size=1)
+        ctx = train_session.TrainContext(
+            world_rank=0, world_size=1,
+            latest_checkpoint=Checkpoint(checkpoint_path)
+            if checkpoint_path else None,
+        )
         ctx._stop_event = self._stop
         self._ctx = ctx
 
@@ -136,8 +144,12 @@ class _TrialActor:
                 item = ctx._report_queue.get()
                 with self._lock:
                     self._reports.append(item["metrics"])
+                    if item.get("checkpoint"):
+                        self._ckpt = item["checkpoint"]
         with self._lock:
-            out = {"reports": list(self._reports), "done": self._done, "error": self._error}
+            out = {"reports": list(self._reports), "done": self._done,
+                   "error": self._error,
+                   "checkpoint": getattr(self, "_ckpt", None)}
             self._reports.clear()
         return out
 
@@ -188,56 +200,118 @@ class Tuner:
         queue = list(pending)
         running: Dict[str, Any] = {}  # trial_id -> (actor, TrialResult)
         finished: List[TrialResult] = []
+        ckpts: Dict[str, str] = {}  # trial_id -> latest checkpoint path
 
+        def _launch(tr: TrialResult, checkpoint_path: Optional[str] = None):
+            actor = _TrialActor.options(
+                max_concurrency=4,
+                num_cpus=self._resources.get("CPU", 1),
+                num_tpus=self._resources.get("TPU", 0),
+            ).remote()
+            try:
+                ray_tpu.get(actor.start.remote(fn_b, tr.config, checkpoint_path))
+            except Exception:
+                # couldn't place the actor (cluster full) — retry later
+                try:
+                    ray_tpu.kill(actor)
+                except Exception:
+                    pass
+                return None
+            if hasattr(scheduler, "register"):
+                scheduler.register(tr.trial_id, tr.config)
+            return actor
+
+        last_progress = time.monotonic()
         while queue or running:
             # launch up to max_conc; scheduling pressure backs off instead
             # of failing the trial
             while queue and len(running) < max_conc:
                 tr = queue.pop(0)
-                actor = _TrialActor.options(
-                    max_concurrency=4,
-                    num_cpus=self._resources.get("CPU", 1),
-                    num_tpus=self._resources.get("TPU", 0),
-                ).remote()
-                try:
-                    ray_tpu.get(actor.start.remote(fn_b, tr.config))
-                except Exception:
-                    # couldn't place the actor (cluster full) — retry later
-                    try:
-                        ray_tpu.kill(actor)
-                    except Exception:
-                        pass
+                actor = _launch(tr, tr.restart_ckpt)
+                if actor is None:
                     queue.insert(0, tr)
                     max_conc = max(1, len(running))
+                    # nothing running and nothing placeable: the trial's
+                    # resource request can never be satisfied — fail it
+                    # instead of spinning forever (reference: infeasible
+                    # trials error out in TuneController)
+                    if not running and time.monotonic() - last_progress > 60:
+                        tr = queue.pop(0)
+                        tr.error = (
+                            "trial unplaceable: resource request "
+                            f"{self._resources} cannot be satisfied"
+                        )
+                        finished.append(tr)
                     break
                 running[tr.trial_id] = (actor, tr)
-            # poll
+                last_progress = time.monotonic()
+            # poll — two phases: gather every trial's state (so donor
+            # checkpoints are recorded regardless of iteration order),
+            # then feed reports to the scheduler
             time.sleep(0.05)
+            states: Dict[str, Dict] = {}
             for tid in list(running):
                 actor, tr = running[tid]
                 try:
-                    state = ray_tpu.get(actor.poll.remote())
+                    states[tid] = ray_tpu.get(actor.poll.remote())
                 except Exception as e:  # actor died
                     tr.error = f"trial actor died: {e}"
                     finished.append(tr)
                     running.pop(tid)
                     continue
+                if states[tid].get("checkpoint"):
+                    ckpts[tid] = states[tid]["checkpoint"]
+            for tid, state in states.items():
+                if tid not in running:
+                    continue
+                actor, tr = running[tid]
                 for rep in state["reports"]:
                     tr.history.append(rep)
                     tr.metrics = rep
-                    if scheduler.on_result(tid, rep) == STOP and not state["done"]:
+                    decision = scheduler.on_result(tid, rep)
+                    if decision == STOP and not state["done"]:
                         try:
                             actor.stop.remote()
                         except Exception:
                             pass
-                if state["done"]:
-                    tr.error = state["error"]
-                    finished.append(tr)
-                    running.pop(tid)
-                    try:
-                        ray_tpu.kill(actor)
-                    except Exception:
-                        pass
+                    elif decision == EXPLOIT:
+                        donor, new_cfg = scheduler.exploit_info(tid)
+                        import os as _os
+                        if _os.environ.get("RAY_TPU_TUNE_DEBUG"):
+                            print(f"[tune] EXPLOIT {tid} donor={donor} "
+                                  f"done={state['done']} "
+                                  f"donor_ckpt={ckpts.get(donor)}")
+                        if state["done"] or ckpts.get(donor) is None:
+                            # trial already finished, or the donor hasn't
+                            # checkpointed yet — drop; PBT retries at the
+                            # next interval boundary (re-register the old
+                            # config: the mutation was not applied)
+                            if hasattr(scheduler, "register"):
+                                scheduler.register(tid, tr.config)
+                            continue
+                        # PBT: restart this trial from the donor's
+                        # checkpoint with a perturbed config
+                        try:
+                            actor.stop.remote()
+                            ray_tpu.kill(actor, no_restart=True)
+                        except Exception:
+                            pass
+                        running.pop(tid)
+                        tr.config = new_cfg
+                        tr.restart_ckpt = ckpts.get(donor)
+                        queue.insert(0, tr)
+                        last_progress = time.monotonic()
+                        break
+                else:
+                    if state["done"]:
+                        tr.error = state["error"]
+                        finished.append(tr)
+                        running.pop(tid)
+                        last_progress = time.monotonic()
+                        try:
+                            ray_tpu.kill(actor)
+                        except Exception:
+                            pass
         return ResultGrid(finished, self._cfg.metric, self._cfg.mode)
 
 
