@@ -1,19 +1,34 @@
 """ray_tpu.rllib — reinforcement learning (reference: rllib/).
 
-PPO with CPU env-runner actors + a jitted JAX learner; built-in
-gymnasium-compatible env API (numpy CartPole included).
+Algorithms (reference: rllib/algorithms/): PPO, DQN, SAC (discrete),
+IMPALA (V-trace) — all with the same TPU-first shape: CPU env-runner
+actors collect trajectories; the learner is ONE jitted JAX program.
+Built-in gymnasium-compatible env API (numpy CartPole included).
 """
 
+from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env import CartPole, Env, make_env, register_env
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, vtrace_np
 from ray_tpu.rllib.ppo import PPO, PPOConfig, PPOLearner, compute_gae
+from ray_tpu.rllib.rollout import ReplayBuffer, SampleRunner
+from ray_tpu.rllib.sac import SAC, SACConfig
 
 __all__ = [
     "CartPole",
+    "DQN",
+    "DQNConfig",
     "Env",
+    "IMPALA",
+    "IMPALAConfig",
     "PPO",
     "PPOConfig",
     "PPOLearner",
+    "ReplayBuffer",
+    "SAC",
+    "SACConfig",
+    "SampleRunner",
     "compute_gae",
     "make_env",
     "register_env",
+    "vtrace_np",
 ]

@@ -1,0 +1,224 @@
+"""DQN — double Q-learning with a target network and replay buffer.
+
+Reference: rllib/algorithms/dqn/dqn.py (`DQN`, training_step) and
+dqn_rainbow_learner.py. TPU-first shape: CPU env-runner actors collect
+with epsilon-greedy; the learner is ONE jitted update (double-DQN
+target, Huber loss) so every minibatch rides the MXU; the target net is
+a pytree copy synced every ``target_network_update_freq`` updates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.rollout import (
+    ReplayBuffer, SampleRunner, init_mlp_params, mlp_apply,
+)
+
+
+def init_q_params(key, obs_dim: int, num_actions: int,
+                  hidden: Tuple[int, ...]):
+    return {"q": init_mlp_params(key, obs_dim, hidden, num_actions)}
+
+
+def q_values(params, obs, n_hidden: int):
+    return mlp_apply(params["q"], obs, n_hidden)
+
+
+@dataclasses.dataclass
+class DQNConfig:
+    """Builder-style config (reference: DQNConfig, dqn.py)."""
+
+    env: Any = "CartPole-v1"
+    num_env_runners: int = 1
+    rollout_fragment_length: int = 64
+    lr: float = 5e-4
+    gamma: float = 0.99
+    buffer_capacity: int = 50_000
+    learning_starts: int = 500
+    train_batch_size: int = 64
+    updates_per_iteration: int = 16
+    target_network_update_freq: int = 100  # in updates
+    epsilon_initial: float = 1.0
+    epsilon_final: float = 0.05
+    epsilon_decay_iters: int = 30
+    double_q: bool = True
+    hidden: Tuple[int, ...] = (64, 64)
+    seed: int = 0
+
+    def environment(self, env) -> "DQNConfig":
+        self.env = env
+        return self
+
+    def env_runners(self, num_env_runners: int,
+                    rollout_fragment_length: Optional[int] = None) -> "DQNConfig":
+        self.num_env_runners = num_env_runners
+        if rollout_fragment_length:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kw) -> "DQNConfig":
+        for k, v in kw.items():
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "DQN":
+        return DQN(self)
+
+
+class DQNLearner:
+    def __init__(self, cfg: DQNConfig, obs_dim: int, num_actions: int):
+        import jax
+        import optax
+
+        self.cfg = cfg
+        self.n_hidden = len(cfg.hidden)
+        self.params = init_q_params(
+            jax.random.key(cfg.seed), obs_dim, num_actions, cfg.hidden)
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        self.num_updates = 0
+        self._update = jax.jit(self._make_update())
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.cfg
+        nh = self.n_hidden
+
+        def loss_fn(params, target_params, batch):
+            q = q_values(params, batch["obs"], nh)
+            q_sel = jnp.take_along_axis(
+                q, batch["actions"][:, None], axis=1)[:, 0]
+            q_next_t = q_values(target_params, batch["next_obs"], nh)
+            if cfg.double_q:
+                # double DQN: online net selects, target net evaluates
+                a_star = jnp.argmax(
+                    q_values(params, batch["next_obs"], nh), axis=1)
+                q_next = jnp.take_along_axis(
+                    q_next_t, a_star[:, None], axis=1)[:, 0]
+            else:
+                q_next = q_next_t.max(axis=1)
+            target = batch["rewards"] + cfg.gamma * q_next * (
+                1.0 - batch["terminateds"].astype(jnp.float32))
+            target = jax.lax.stop_gradient(target)
+            td = q_sel - target
+            # Huber
+            loss = jnp.mean(jnp.where(
+                jnp.abs(td) < 1.0, 0.5 * td * td, jnp.abs(td) - 0.5))
+            return loss, {"td_error_mean": jnp.mean(jnp.abs(td)),
+                          "qf_mean": jnp.mean(q_sel)}
+
+        def update(params, target_params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, batch)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, dict(aux, loss=loss)
+
+        return update
+
+    def update(self, batch_np: Dict[str, np.ndarray]) -> Dict[str, float]:
+        import jax
+        import jax.numpy as jnp
+
+        batch = {k: jnp.asarray(v) for k, v in batch_np.items()}
+        self.params, self.opt_state, metrics = self._update(
+            self.params, self.target_params, self.opt_state, batch)
+        self.num_updates += 1
+        if self.num_updates % self.cfg.target_network_update_freq == 0:
+            self.target_params = jax.tree.map(lambda x: x, self.params)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights_np(self) -> Dict:
+        import jax
+
+        return jax.tree.map(lambda x: np.asarray(x), self.params)
+
+
+class DQN:
+    """Reference: rllib/algorithms/dqn/dqn.py `DQN.training_step`:
+    sample → store in replay → N minibatch updates → sync target."""
+
+    def __init__(self, cfg: DQNConfig):
+        probe = make_env(cfg.env)
+        self.cfg = cfg
+        self.obs_dim = probe.observation_dim
+        self.num_actions = probe.num_actions
+        self.learner = DQNLearner(cfg, self.obs_dim, self.num_actions)
+        self.buffer = ReplayBuffer(cfg.buffer_capacity, self.obs_dim, cfg.seed)
+        self.runners = [
+            SampleRunner.remote(cfg.env, cfg.hidden, cfg.seed + i,
+                                mode="epsilon", net_key="q")
+            for i in range(cfg.num_env_runners)
+        ]
+        self.iteration = 0
+        self._recent_returns: List[float] = []
+
+    def _epsilon(self) -> float:
+        cfg = self.cfg
+        frac = min(1.0, self.iteration / max(1, cfg.epsilon_decay_iters))
+        return cfg.epsilon_initial + frac * (
+            cfg.epsilon_final - cfg.epsilon_initial)
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.cfg
+        weights = self.learner.get_weights_np()
+        eps = self._epsilon()
+        frags = ray_tpu.get([
+            r.sample.remote(weights, cfg.rollout_fragment_length, eps)
+            for r in self.runners
+        ])
+        for f in frags:
+            self.buffer.add_batch(f)
+            self._recent_returns.extend(f["episode_returns"].tolist())
+        metrics: Dict[str, float] = {}
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.updates_per_iteration):
+                metrics = self.learner.update(
+                    self.buffer.sample(cfg.train_batch_size))
+        self.iteration += 1
+        self._recent_returns = self._recent_returns[-100:]
+        mean_ret = float(np.mean(self._recent_returns)) \
+            if self._recent_returns else 0.0
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": mean_ret,
+            "epsilon": eps,
+            "replay_buffer_size": len(self.buffer),
+            **metrics,
+        }
+
+    def stop(self) -> None:
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+
+    def save(self, path: str) -> None:
+        from ray_tpu.train.checkpoint import save_state
+
+        save_state({"params": self.learner.params,
+                    "target": self.learner.target_params,
+                    "opt_state": self.learner.opt_state}, path)
+
+    def restore(self, path: str) -> None:
+        from ray_tpu.train.checkpoint import restore_state
+
+        state = restore_state(path, target={
+            "params": self.learner.params,
+            "target": self.learner.target_params,
+            "opt_state": self.learner.opt_state,
+        })
+        self.learner.params = state["params"]
+        self.learner.target_params = state["target"]
+        self.learner.opt_state = state["opt_state"]

@@ -1,0 +1,284 @@
+"""IMPALA — asynchronous actor-learner with V-trace off-policy correction.
+
+Reference: rllib/algorithms/impala/impala.py:643 (`IMPALA`) — env-runner
+actors sample continuously with (possibly stale) behavior weights while
+the learner consumes fragments as they arrive; the staleness is
+corrected by V-trace (Espeholt et al., public algorithm). TPU-first
+shape: the V-trace recursion is a `lax.scan` inside ONE jitted update;
+the async part is host-side `ray_tpu.wait` over in-flight sample
+futures, resubmitting each runner with fresh weights as it returns.
+
+Mid-fragment truncations are treated as terminations for the discount
+(small value bias at time-limit boundaries; the fragment TAIL always
+bootstraps from V(last_obs)).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.ppo import init_policy, policy_logits, value_fn
+from ray_tpu.rllib.rollout import SampleRunner
+
+
+def vtrace_np(values, next_values, rewards, discounts, rhos, cs,
+              rho_bar: float = 1.0, c_bar: float = 1.0):
+    """Naive numpy V-trace (reference implementation for tests).
+
+    values/next_values/rewards/discounts/rhos/cs: [T].
+    Returns (vs, pg_advantages)."""
+    T = len(values)
+    rhos_c = np.minimum(rho_bar, rhos)
+    cs_c = np.minimum(c_bar, cs)
+    vs = np.zeros(T, np.float64)
+    acc = 0.0  # carries vs_{t+1} - V(x_{t+1})
+    for t in reversed(range(T)):
+        delta = rhos_c[t] * (
+            rewards[t] + discounts[t] * next_values[t] - values[t])
+        acc = delta + discounts[t] * cs_c[t] * acc
+        vs[t] = values[t] + acc
+    vs_next = np.concatenate([vs[1:], [next_values[-1]]])
+    pg_adv = rhos_c * (rewards + discounts * vs_next - values)
+    return vs, pg_adv
+
+
+def vtrace_jax(values, next_values, rewards, discounts, rhos, cs,
+               rho_bar: float = 1.0, c_bar: float = 1.0):
+    """lax.scan V-trace used by the learner's jitted loss (tested against
+    ``vtrace_np``). All inputs [T]; returns (vs, pg_advantages)."""
+    import jax
+    import jax.numpy as jnp
+
+    rhos_c = jnp.minimum(rho_bar, rhos)
+    cs_c = jnp.minimum(c_bar, cs)
+    deltas = rhos_c * (rewards + discounts * next_values - values)
+
+    def scan_step(acc, xs):
+        delta, disc_c = xs
+        acc = delta + disc_c * acc
+        return acc, acc
+
+    _, accs = jax.lax.scan(
+        scan_step, 0.0, (deltas, discounts * cs_c), reverse=True)
+    vs = values + accs
+    vs_next = jnp.concatenate([vs[1:], next_values[-1:]])
+    pg_adv = rhos_c * (rewards + discounts * vs_next - values)
+    return vs, pg_adv
+
+
+@dataclasses.dataclass
+class IMPALAConfig:
+    """Builder-style config (reference: IMPALAConfig, impala.py)."""
+
+    env: Any = "CartPole-v1"
+    num_env_runners: int = 2
+    rollout_fragment_length: int = 128
+    lr: float = 5e-4
+    gamma: float = 0.99
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    rho_bar: float = 1.0  # V-trace importance clips
+    c_bar: float = 1.0
+    fragments_per_iteration: int = 4
+    hidden: Tuple[int, ...] = (64, 64)
+    seed: int = 0
+
+    def environment(self, env) -> "IMPALAConfig":
+        self.env = env
+        return self
+
+    def env_runners(self, num_env_runners: int,
+                    rollout_fragment_length: Optional[int] = None) -> "IMPALAConfig":
+        self.num_env_runners = num_env_runners
+        if rollout_fragment_length:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kw) -> "IMPALAConfig":
+        for k, v in kw.items():
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "IMPALA":
+        return IMPALA(self)
+
+
+class IMPALALearner:
+    def __init__(self, cfg: IMPALAConfig, obs_dim: int, num_actions: int):
+        import jax
+        import optax
+
+        self.cfg = cfg
+        self.n_hidden = len(cfg.hidden)
+        self.params = init_policy(
+            jax.random.key(cfg.seed), obs_dim, num_actions, cfg.hidden)
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        self._update = jax.jit(self._make_update())
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.cfg
+        nh = self.n_hidden
+
+        def loss_fn(params, batch):
+            logits = policy_logits(params, batch["obs"], nh)
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None], axis=1)[:, 0]
+            values = value_fn(params, batch["obs"], nh)
+            # V(x_{t+1}): next value within the fragment; tail bootstraps
+            # from V(last_obs)
+            last_v = value_fn(params, batch["last_obs"][None, :], nh)[0]
+            next_values = jnp.concatenate([values[1:], last_v[None]])
+            ratios = jnp.exp(logp - batch["logp"])
+            discounts = cfg.gamma * (
+                1.0 - batch["dones"].astype(jnp.float32))
+            vs, pg_adv = vtrace_jax(
+                jax.lax.stop_gradient(values),
+                jax.lax.stop_gradient(next_values),
+                batch["rewards"], discounts,
+                jax.lax.stop_gradient(ratios),
+                jax.lax.stop_gradient(ratios),
+                rho_bar=cfg.rho_bar, c_bar=cfg.c_bar,
+            )
+            rhos = jnp.minimum(cfg.rho_bar, ratios)
+
+            pg_loss = -jnp.mean(logp * pg_adv)
+            vf_loss = 0.5 * jnp.mean((values - vs) ** 2)
+            entropy = -jnp.mean(
+                jnp.sum(jnp.exp(logp_all) * logp_all, axis=1))
+            loss = pg_loss + cfg.vf_coeff * vf_loss \
+                - cfg.entropy_coeff * entropy
+            return loss, {"pg_loss": pg_loss, "vf_loss": vf_loss,
+                          "entropy": entropy,
+                          "mean_rho": jnp.mean(rhos)}
+
+        def update(params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, dict(aux, total_loss=loss)
+
+        return update
+
+    def update(self, frag: Dict[str, np.ndarray]) -> Dict[str, float]:
+        import jax.numpy as jnp
+
+        dones = np.logical_or(frag["terminateds"], frag["truncs"])
+        batch = {
+            "obs": jnp.asarray(frag["obs"]),
+            "actions": jnp.asarray(frag["actions"]),
+            "rewards": jnp.asarray(frag["rewards"]),
+            "dones": jnp.asarray(dones),
+            "logp": jnp.asarray(frag["logp"]),
+            "last_obs": jnp.asarray(frag["last_obs"]),
+        }
+        self.params, self.opt_state, metrics = self._update(
+            self.params, self.opt_state, batch)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights_np(self) -> Dict:
+        import jax
+
+        return jax.tree.map(lambda x: np.asarray(x), self.params)
+
+    def get_policy_np(self) -> Dict:
+        """Only the actor net — the runners don't read the vf head."""
+        import jax
+
+        return {"pi": jax.tree.map(lambda x: np.asarray(x),
+                                   self.params["pi"])}
+
+
+class IMPALA:
+    """Async actor-learner (reference: impala.py:643): runners always
+    have a sample in flight; the learner consumes whichever fragment
+    lands first and hands that runner fresh weights."""
+
+    def __init__(self, cfg: IMPALAConfig):
+        probe = make_env(cfg.env)
+        self.cfg = cfg
+        self.obs_dim = probe.observation_dim
+        self.num_actions = probe.num_actions
+        self.learner = IMPALALearner(cfg, self.obs_dim, self.num_actions)
+        self.runners = [
+            SampleRunner.remote(cfg.env, cfg.hidden, cfg.seed + i,
+                                mode="categorical", net_key="pi")
+            for i in range(cfg.num_env_runners)
+        ]
+        self.iteration = 0
+        self._recent_returns: List[float] = []
+        self._inflight: Dict[Any, Any] = {}  # future -> runner
+
+    def _submit(self, runner) -> None:
+        w = self.learner.get_policy_np()
+        fut = runner.sample.remote(w, self.cfg.rollout_fragment_length)
+        self._inflight[fut] = runner
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.cfg
+        if not self._inflight:
+            for r in self.runners:
+                self._submit(r)
+        metrics: Dict[str, float] = {}
+        processed = 0
+        while processed < cfg.fragments_per_iteration:
+            ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1)
+            fut = ready[0]
+            runner = self._inflight.pop(fut)
+            frag = ray_tpu.get(fut)
+            self._submit(runner)  # keep the pipe full with fresh weights
+            metrics = self.learner.update(frag)
+            self._recent_returns.extend(frag["episode_returns"].tolist())
+            processed += 1
+        self.iteration += 1
+        self._recent_returns = self._recent_returns[-100:]
+        mean_ret = float(np.mean(self._recent_returns)) \
+            if self._recent_returns else 0.0
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": mean_ret,
+            "num_env_steps_sampled":
+                cfg.fragments_per_iteration * cfg.rollout_fragment_length,
+            **metrics,
+        }
+
+    def stop(self) -> None:
+        # drain in-flight samples so runner kills don't race
+        for fut in list(self._inflight):
+            try:
+                ray_tpu.cancel(fut)
+            except Exception:
+                pass
+        self._inflight.clear()
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+
+    def save(self, path: str) -> None:
+        from ray_tpu.train.checkpoint import save_state
+
+        save_state({"params": self.learner.params,
+                    "opt_state": self.learner.opt_state}, path)
+
+    def restore(self, path: str) -> None:
+        from ray_tpu.train.checkpoint import restore_state
+
+        state = restore_state(path, target={
+            "params": self.learner.params,
+            "opt_state": self.learner.opt_state,
+        })
+        self.learner.params = state["params"]
+        self.learner.opt_state = state["opt_state"]

@@ -1,0 +1,249 @@
+"""SAC (discrete) — twin soft Q-critics, entropy-regularized policy,
+auto-tuned temperature.
+
+Reference: rllib/algorithms/sac/sac.py (`SAC`) and sac_learner.py; the
+discrete-action formulation follows the public derivation (expectations
+over the categorical policy instead of the reparameterization trick).
+TPU-first shape as with DQN/PPO: CPU runners sample from the softmax
+policy; one jitted update trains actor, both critics, and alpha; target
+critics track by polyak averaging inside the same jitted step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.rollout import (
+    ReplayBuffer, SampleRunner, init_mlp_params, mlp_apply as _mlp,
+)
+
+
+@dataclasses.dataclass
+class SACConfig:
+    """Builder-style config (reference: SACConfig, sac.py)."""
+
+    env: Any = "CartPole-v1"
+    num_env_runners: int = 1
+    rollout_fragment_length: int = 64
+    lr: float = 3e-4
+    gamma: float = 0.99
+    tau: float = 0.01  # polyak rate for target critics
+    buffer_capacity: int = 50_000
+    learning_starts: int = 500
+    train_batch_size: int = 64
+    updates_per_iteration: int = 16
+    initial_alpha: float = 0.2
+    target_entropy: Optional[float] = None  # default 0.98*log(n_actions)
+    hidden: Tuple[int, ...] = (64, 64)
+    seed: int = 0
+
+    def environment(self, env) -> "SACConfig":
+        self.env = env
+        return self
+
+    def env_runners(self, num_env_runners: int,
+                    rollout_fragment_length: Optional[int] = None) -> "SACConfig":
+        self.num_env_runners = num_env_runners
+        if rollout_fragment_length:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kw) -> "SACConfig":
+        for k, v in kw.items():
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "SAC":
+        return SAC(self)
+
+
+class SACLearner:
+    def __init__(self, cfg: SACConfig, obs_dim: int, num_actions: int):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.cfg = cfg
+        self.n_hidden = len(cfg.hidden)
+        k = jax.random.split(jax.random.key(cfg.seed), 3)
+        self.params = {
+            "pi": init_mlp_params(k[0], obs_dim, cfg.hidden, num_actions),
+            "q1": init_mlp_params(k[1], obs_dim, cfg.hidden, num_actions),
+            "q2": init_mlp_params(k[2], obs_dim, cfg.hidden, num_actions),
+            "log_alpha": jnp.asarray(np.log(cfg.initial_alpha), jnp.float32),
+        }
+        self.target = {"q1": jax.tree.map(lambda x: x, self.params["q1"]),
+                       "q2": jax.tree.map(lambda x: x, self.params["q2"])}
+        self.target_entropy = cfg.target_entropy if cfg.target_entropy \
+            is not None else 0.98 * float(np.log(num_actions))
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        self._update = jax.jit(self._make_update())
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.cfg
+        nh = self.n_hidden
+        h_target = self.target_entropy
+
+        def loss_fn(params, target, batch):
+            # categorical policy distribution at s and s'
+            logits = _mlp(params["pi"], batch["obs"], nh)
+            logp = jax.nn.log_softmax(logits)
+            p = jnp.exp(logp)
+            logits_n = _mlp(params["pi"], batch["next_obs"], nh)
+            logp_n = jax.nn.log_softmax(logits_n)
+            p_n = jnp.exp(logp_n)
+            alpha = jnp.exp(params["log_alpha"])
+
+            # soft Q target: E_{a'~pi}[min Q_t(s',a') - alpha log pi(a'|s')]
+            q1_t = _mlp(target["q1"], batch["next_obs"], nh)
+            q2_t = _mlp(target["q2"], batch["next_obs"], nh)
+            v_next = jnp.sum(
+                p_n * (jnp.minimum(q1_t, q2_t)
+                       - jax.lax.stop_gradient(alpha) * logp_n), axis=1)
+            y = batch["rewards"] + cfg.gamma * v_next * (
+                1.0 - batch["terminateds"].astype(jnp.float32))
+            y = jax.lax.stop_gradient(y)
+
+            q1 = jnp.take_along_axis(
+                _mlp(params["q1"], batch["obs"], nh),
+                batch["actions"][:, None], axis=1)[:, 0]
+            q2 = jnp.take_along_axis(
+                _mlp(params["q2"], batch["obs"], nh),
+                batch["actions"][:, None], axis=1)[:, 0]
+            critic_loss = jnp.mean((q1 - y) ** 2) + jnp.mean((q2 - y) ** 2)
+
+            # actor: E_s[ sum_a pi(a|s) (alpha log pi - min Q) ], Q frozen
+            q_min = jax.lax.stop_gradient(jnp.minimum(
+                _mlp(params["q1"], batch["obs"], nh),
+                _mlp(params["q2"], batch["obs"], nh)))
+            actor_loss = jnp.mean(jnp.sum(
+                p * (jax.lax.stop_gradient(alpha) * logp - q_min), axis=1))
+
+            # temperature: match target entropy
+            entropy = -jnp.sum(jax.lax.stop_gradient(p * logp), axis=1)
+            alpha_loss = jnp.mean(
+                jnp.exp(params["log_alpha"]) * (entropy - h_target))
+
+            loss = critic_loss + actor_loss + alpha_loss
+            return loss, {"critic_loss": critic_loss,
+                          "actor_loss": actor_loss,
+                          "alpha": alpha,
+                          "entropy_mean": jnp.mean(entropy)}
+
+        def update(params, target, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target, batch)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            # polyak target tracking, same jitted step
+            target = {
+                net: jax.tree.map(
+                    lambda t, o: (1 - cfg.tau) * t + cfg.tau * o,
+                    target[net], params[net])
+                for net in ("q1", "q2")
+            }
+            return params, target, opt_state, dict(aux, loss=loss)
+
+        return update
+
+    def update(self, batch_np: Dict[str, np.ndarray]) -> Dict[str, float]:
+        import jax.numpy as jnp
+
+        batch = {k: jnp.asarray(v) for k, v in batch_np.items()}
+        self.params, self.target, self.opt_state, metrics = self._update(
+            self.params, self.target, self.opt_state, batch)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights_np(self) -> Dict:
+        import jax
+
+        return jax.tree.map(lambda x: np.asarray(x), self.params)
+
+    def get_policy_np(self) -> Dict:
+        """Only the actor net — all the runners need, 1/3 the payload."""
+        import jax
+
+        return {"pi": jax.tree.map(lambda x: np.asarray(x),
+                                   self.params["pi"])}
+
+
+class SAC:
+    """Reference: rllib/algorithms/sac/sac.py — training_step is DQN's
+    (sample → replay → updates) with the SAC losses."""
+
+    def __init__(self, cfg: SACConfig):
+        probe = make_env(cfg.env)
+        self.cfg = cfg
+        self.obs_dim = probe.observation_dim
+        self.num_actions = probe.num_actions
+        self.learner = SACLearner(cfg, self.obs_dim, self.num_actions)
+        self.buffer = ReplayBuffer(cfg.buffer_capacity, self.obs_dim, cfg.seed)
+        self.runners = [
+            SampleRunner.remote(cfg.env, cfg.hidden, cfg.seed + i,
+                                mode="categorical", net_key="pi")
+            for i in range(cfg.num_env_runners)
+        ]
+        self.iteration = 0
+        self._recent_returns: List[float] = []
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.cfg
+        weights = self.learner.get_policy_np()
+        frags = ray_tpu.get([
+            r.sample.remote(weights, cfg.rollout_fragment_length)
+            for r in self.runners
+        ])
+        for f in frags:
+            self.buffer.add_batch(f)
+            self._recent_returns.extend(f["episode_returns"].tolist())
+        metrics: Dict[str, float] = {}
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.updates_per_iteration):
+                metrics = self.learner.update(
+                    self.buffer.sample(cfg.train_batch_size))
+        self.iteration += 1
+        self._recent_returns = self._recent_returns[-100:]
+        mean_ret = float(np.mean(self._recent_returns)) \
+            if self._recent_returns else 0.0
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": mean_ret,
+            "replay_buffer_size": len(self.buffer),
+            **metrics,
+        }
+
+    def stop(self) -> None:
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+
+    def save(self, path: str) -> None:
+        from ray_tpu.train.checkpoint import save_state
+
+        save_state({"params": self.learner.params,
+                    "target": self.learner.target,
+                    "opt_state": self.learner.opt_state}, path)
+
+    def restore(self, path: str) -> None:
+        from ray_tpu.train.checkpoint import restore_state
+
+        state = restore_state(path, target={
+            "params": self.learner.params,
+            "target": self.learner.target,
+            "opt_state": self.learner.opt_state,
+        })
+        self.learner.params = state["params"]
+        self.learner.target = state["target"]
+        self.learner.opt_state = state["opt_state"]

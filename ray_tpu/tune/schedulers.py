@@ -99,7 +99,13 @@ class HyperBandScheduler:
         self.time_attr = time_attr
         self.max_t = max_t
         self.rf = reduction_factor
-        s_max = max(1, int(math.log(max_t, reduction_factor)))
+        # integer bracket count (math.log floats drop a bracket at exact
+        # powers, e.g. log(243, 3) == 4.999...)
+        s_max, t = 0, max_t
+        while t >= reduction_factor:
+            t //= reduction_factor
+            s_max += 1
+        s_max = max(1, s_max)
         self._brackets = [
             ASHAScheduler(
                 metric=metric, mode=mode, time_attr=time_attr, max_t=max_t,
@@ -138,7 +144,7 @@ class PopulationBasedTraining:
     def __init__(
         self,
         metric: str = "loss",
-        mode: str = "max",
+        mode: str = "min",
         time_attr: str = "training_iteration",
         perturbation_interval: int = 1,
         hyperparam_mutations: Optional[Dict[str, Any]] = None,

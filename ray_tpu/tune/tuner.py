@@ -299,6 +299,9 @@ class Tuner:
                         running.pop(tid)
                         tr.config = new_cfg
                         tr.restart_ckpt = ckpts.get(donor)
+                        # the pre-restart checkpoint no longer matches the
+                        # trial's config — don't let anyone exploit it
+                        ckpts.pop(tid, None)
                         queue.insert(0, tr)
                         last_progress = time.monotonic()
                         break
