@@ -1,0 +1,32 @@
+"""ray_tpu.llm — LLM batch inference and serving (reference: python/ray/llm).
+
+The reference wraps vLLM (`python/ray/llm/_internal/batch/`, `_internal/
+serve/engines/vllm/`); here the engine is JAX-native: KV-cache prefill +
+decode over the flagship transformer (ray_tpu/models/decoding.py), so
+generation compiles to two XLA programs (prefill, per-token decode) and
+runs on the TPU MXU.
+
+- ``LLMConfig`` — model + generation + deployment settings
+- ``LLMEngine`` — in-process generator (tokenize → generate → detokenize)
+- ``build_llm_processor`` — batch inference over ray_tpu.data Datasets
+- ``build_llm_deployment`` / ``serve_llm`` — a Serve deployment with
+  request batching and streaming token responses
+"""
+
+from ray_tpu.llm.config import ByteTokenizer, LLMConfig
+from ray_tpu.llm.engine import LLMEngine
+from ray_tpu.llm.batch import build_llm_processor
+from ray_tpu.llm.serving import build_llm_deployment, serve_llm
+
+from ray_tpu.models.decoding import Generator, SamplingParams
+
+__all__ = [
+    "ByteTokenizer",
+    "Generator",
+    "LLMConfig",
+    "LLMEngine",
+    "SamplingParams",
+    "build_llm_deployment",
+    "build_llm_processor",
+    "serve_llm",
+]

@@ -1,0 +1,64 @@
+"""LLM batch inference over ray_tpu.data (reference:
+python/ray/llm/_internal/batch/processor/ — vLLM engine processors).
+
+``build_llm_processor(config)`` returns ``Dataset -> Dataset``: each
+data-worker process lazily builds ONE engine (cached per config) and
+maps prompt batches through it, so generation parallelism follows the
+Data executor's task parallelism and blocks stream (no full
+materialization on the driver).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.llm.config import LLMConfig
+from ray_tpu.models.decoding import SamplingParams
+
+# one engine per (worker process, config identity) — map_batches fns run
+# in data-executor worker processes; rebuilding the engine per block
+# would recompile prefill/decode every time
+_ENGINE_CACHE: Dict[tuple, Any] = {}
+
+
+def _engine_for(config: LLMConfig):
+    # stable across pickling into data-worker processes (id() is not);
+    # class name alone can't distinguish two HF tokenizers, so include
+    # their vocab/name attributes too
+    tok = config.get_tokenizer()
+    key = (str(config.model), config.max_len, config.params_path,
+           config.seed, type(tok).__name__,
+           getattr(tok, "vocab_size", None),
+           str(getattr(tok, "name_or_path", None)))
+    eng = _ENGINE_CACHE.get(key)
+    if eng is None:
+        from ray_tpu.llm.engine import LLMEngine
+
+        eng = LLMEngine(config)
+        _ENGINE_CACHE[key] = eng
+    return eng
+
+
+def build_llm_processor(
+    config: LLMConfig,
+    *,
+    sampling: Optional[SamplingParams] = None,
+    prompt_column: str = "prompt",
+    output_column: str = "generated",
+    batch_size: Optional[int] = None,
+) -> Callable:
+    """Returns ``process(ds) -> ds`` adding ``output_column`` with the
+    completion for each row's ``prompt_column``."""
+
+    def _infer(batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        eng = _engine_for(config)
+        prompts = [str(p) for p in batch[prompt_column]]
+        outs = eng.generate(prompts, sampling)
+        return dict(batch, **{output_column: np.asarray(outs, object)})
+
+    def process(ds):
+        return ds.map_batches(_infer, batch_size=batch_size)
+
+    return process

@@ -1,0 +1,70 @@
+"""In-process LLM engine: tokenizer + compiled generator.
+
+Reference: the vLLM engine wrapper (python/ray/llm/_internal/serve/
+engines/vllm/vllm_engine.py) — ours drives ray_tpu.models.decoding.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from ray_tpu.llm.config import LLMConfig
+from ray_tpu.models.decoding import Generator, SamplingParams
+
+
+class LLMEngine:
+    def __init__(self, config: LLMConfig):
+        import jax
+
+        from ray_tpu.models import transformer as T
+
+        self.config = config
+        self.tokenizer = config.get_tokenizer()
+        cfg = T.config(config.model)
+        vocab = getattr(self.tokenizer, "vocab_size", None)
+        if vocab and vocab > cfg.vocab_size:
+            # model must cover the tokenizer's id space
+            cfg = T.config(cfg, vocab_size=int(vocab))
+        self.model_config = cfg
+        if config.params_path:
+            from ray_tpu.train.checkpoint import restore_state
+
+            params_shape = jax.eval_shape(
+                lambda: T.init_params(cfg, jax.random.key(0)))
+            params = restore_state(config.params_path, target=params_shape)
+        else:
+            params = T.init_params(cfg, jax.random.key(config.seed))
+        self.generator = Generator(cfg, params, max_len=config.max_len)
+        self._call_count = 0
+
+    def next_seed(self) -> int:
+        """Fresh seed per call: temperature sampling must differ across
+        requests for the same prompt (deterministic given config.seed
+        and call order, so tests stay reproducible)."""
+        self._call_count += 1
+        return self.config.seed + self._call_count
+
+    def generate_tokens(self, prompts: Sequence[Sequence[int]],
+                        sampling: Optional[SamplingParams] = None
+                        ) -> List[List[int]]:
+        sampling = sampling or self.config.sampling
+        return self.generator.generate(
+            [list(p) for p in prompts], sampling, seed=self.next_seed())
+
+    def generate(self, prompts: Sequence[Union[str, Sequence[int]]],
+                 sampling: Optional[SamplingParams] = None) -> List[str]:
+        """Text in → text out (token-id prompts pass through encode)."""
+        tok = self.tokenizer
+        sampling = sampling or self.config.sampling
+        if sampling.stop_token_id is None and \
+                getattr(tok, "eos_token_id", None) is not None:
+            import dataclasses
+
+            sampling = dataclasses.replace(
+                sampling, stop_token_id=tok.eos_token_id)
+        ids = [tok.encode(p) if isinstance(p, str) else list(p)
+               for p in prompts]
+        # empty prompts would index position -1 at prefill; give them BOS=0
+        ids = [p if p else [0] for p in ids]
+        outs = self.generate_tokens(ids, sampling)
+        return [tok.decode(o) for o in outs]

@@ -1,0 +1,74 @@
+"""LLM serving on ray_tpu.serve (reference: python/ray/llm/_internal/
+serve/ — LLM deployments over vLLM with batched + streamed responses).
+
+``build_llm_deployment(config)`` returns a Serve Application whose
+replica holds one compiled engine:
+
+- ``__call__(prompt)`` — completion text; concurrent requests are
+  merged into one device batch by @serve.batch (MXU utilization),
+- ``generate_stream(prompt)`` — generator of text deltas, served over
+  the handle's streaming path / HTTP chunked responses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ray_tpu import serve
+from ray_tpu.llm.config import LLMConfig
+from ray_tpu.models.decoding import SamplingParams
+
+
+def build_llm_deployment(config: LLMConfig):
+    """Build (not deploy) the Serve application for ``config``."""
+
+    @serve.deployment(
+        name=config.name,
+        num_replicas=config.num_replicas,
+        ray_actor_options=(
+            {"resources": config.resources} if config.resources else None),
+    )
+    class LLMServer:
+        def __init__(self):
+            from ray_tpu.llm.engine import LLMEngine
+
+            self.engine = LLMEngine(config)
+            self.tokenizer = self.engine.tokenizer
+
+        @serve.batch(max_batch_size=config.batch_max_size,
+                     batch_wait_timeout_s=config.batch_wait_timeout_s)
+        def _generate_batch(self, prompts):
+            return self.engine.generate(prompts)
+
+        def __call__(self, prompt: str) -> str:
+            return self._generate_batch(prompt)
+
+        def generate_stream(self, prompt: str,
+                            max_tokens: Optional[int] = None):
+            """Yields text deltas for one prompt (token-level streaming)."""
+            sampling = self.engine.config.sampling
+            if max_tokens is not None:
+                sampling = dataclasses.replace(sampling,
+                                               max_tokens=max_tokens)
+            eos = getattr(self.tokenizer, "eos_token_id", None)
+            if sampling.stop_token_id is None and eos is not None:
+                sampling = dataclasses.replace(sampling, stop_token_id=eos)
+            ids = self.tokenizer.encode(prompt)
+            out_ids = []
+            prev_text = ""
+            for t in self.engine.generator.generate_stream(
+                    ids, sampling, seed=self.engine.next_seed()):
+                out_ids.append(t)
+                text = self.tokenizer.decode(out_ids)
+                delta, prev_text = text[len(prev_text):], text
+                if delta:
+                    yield delta
+
+    return LLMServer.bind()
+
+
+def serve_llm(config: LLMConfig):
+    """Deploy and return the live handle (reference: ray.llm serve
+    entrypoints)."""
+    return serve.run(build_llm_deployment(config), name=config.name)

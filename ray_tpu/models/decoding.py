@@ -1,0 +1,289 @@
+"""Autoregressive decoding with a KV cache for the flagship transformer.
+
+The reference LLM library delegates generation to vLLM
+(python/ray/llm/_internal/serve/engines/vllm/); here the engine is
+JAX-native over ray_tpu.models.transformer — the TPU-first shape:
+
+- prefill: ONE jitted forward over the whole (right-padded) prompt
+  batch writing K/V for every layer into a preallocated cache
+  [L, B, max_len, kvH, D] (static shapes — no per-token recompiles),
+- decode: ONE jitted single-token step per emitted token; the layer
+  stack is a `lax.scan` over (stacked params, cache layers) so the
+  compiled program is independent of depth,
+- sampling (greedy / temperature / top-k) happens on-device; only the
+  emitted token ids cross back to host.
+
+Left-padding-free: prompts are right-padded, per-sequence lengths track
+the true positions, and attention masks cache slots >= the sequence's
+current length.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.models.transformer import (
+    TransformerConfig, _rms_norm, _rope,
+)
+from ray_tpu.ops.attention import NEG_INF
+
+
+class KVCache(NamedTuple):
+    k: jax.Array  # [L, B, max_len, kvH, D]
+    v: jax.Array  # [L, B, max_len, kvH, D]
+    lengths: jax.Array  # [B] — tokens currently in cache per sequence
+
+
+def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
+               dtype=None) -> KVCache:
+    dtype = dtype or cfg.dtype
+    shape = (cfg.layers, batch, max_len, cfg.kv_heads, cfg.hd)
+    return KVCache(
+        k=jnp.zeros(shape, dtype),
+        v=jnp.zeros(shape, dtype),
+        lengths=jnp.zeros((batch,), jnp.int32),
+    )
+
+
+def _attend_cached(q, k_cache, v_cache, q_pos, kv_len_mask):
+    """q [B,S,H,D] against the full cache [B,max_len,kvH,D].
+
+    kv_len_mask [B, max_len] marks valid cache slots; q_pos [B,S] are the
+    global positions of the queries (causal: key position <= q position).
+    """
+    b, s, h, d = q.shape
+    kvh = k_cache.shape[2]
+    rep = h // kvh
+    k = jnp.repeat(k_cache, rep, axis=2) if rep > 1 else k_cache
+    v = jnp.repeat(v_cache, rep, axis=2) if rep > 1 else v_cache
+    logits = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) / (d ** 0.5)
+    t = k_cache.shape[1]
+    key_pos = jnp.arange(t)[None, :]  # [1, max_len]
+    causal = q_pos[:, None, :, None] >= key_pos[:, None, None, :] \
+        if q_pos.ndim == 2 else None
+    mask = kv_len_mask[:, None, None, :] & causal
+    logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhst,bthd->bshd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _block_cached(cfg: TransformerConfig, x, p, lora, positions,
+                  k_cache, v_cache, kv_len_mask):
+    """One decoder block against cached K/V. Returns (x, new_k, new_v)
+    where new_k/new_v are this call's freshly computed K/V [B,S,kvH,D]."""
+    scale = cfg.lora_alpha / cfg.lora_rank if cfg.lora_rank else 0.0
+    b, s, _ = x.shape
+    nh, nkv, hd = cfg.heads, cfg.kv_heads, cfg.hd
+
+    y = _rms_norm(x, p["ln_attn"], cfg.norm_eps)
+    q = jnp.einsum("bsh,hnd->bsnd", y, p["wq"].astype(y.dtype))
+    k = jnp.einsum("bsh,hnd->bsnd", y, p["wk"].astype(y.dtype))
+    v = jnp.einsum("bsh,hnd->bsnd", y, p["wv"].astype(y.dtype))
+    if lora is not None:
+        from ray_tpu.models.transformer import _lora_delta
+
+        q = q + _lora_delta(y, lora["wq_a"], lora["wq_b"], scale).reshape(
+            b, s, nh, hd)
+        v = v + _lora_delta(y, lora["wv_a"], lora["wv_b"], scale).reshape(
+            b, s, nkv, hd)
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+
+    # scatter fresh K/V into the cache at each sequence's positions, then
+    # attend against the whole (masked) cache
+    def put(cache, new):
+        bidx = jnp.arange(b)[:, None]
+        return cache.at[bidx, positions].set(new.astype(cache.dtype))
+
+    k_cache = put(k_cache, k)
+    v_cache = put(v_cache, v)
+    attn = _attend_cached(q, k_cache, v_cache, positions, kv_len_mask)
+    attn = jnp.einsum("bsnd,ndh->bsh", attn, p["wo"].astype(attn.dtype))
+    x = x + attn
+
+    y = _rms_norm(x, p["ln_mlp"], cfg.norm_eps)
+    gate = jnp.einsum("bsh,hm->bsm", y, p["wi_gate"].astype(y.dtype))
+    up = jnp.einsum("bsh,hm->bsm", y, p["wi_up"].astype(y.dtype))
+    if lora is not None:
+        gate = gate + _lora_delta(y, lora["wi_a"], lora["wi_b"], scale)
+    act = jax.nn.silu(gate) * up
+    out = jnp.einsum("bsm,mh->bsh", act, p["wo_mlp"].astype(act.dtype))
+    return x + out, k_cache, v_cache
+
+
+def forward_cached(cfg: TransformerConfig, params, tokens, positions,
+                   cache: KVCache, kv_len_mask):
+    """Forward [B,S] tokens through all layers, reading+writing the cache.
+
+    Returns (logits [B,S,V], new_cache). The layer stack is a lax.scan
+    over (stacked params, cache layers) — one compiled block body.
+    """
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    blocks, lora = params["blocks"], params.get("lora")
+    layer_tree = {"p": blocks}
+    if lora is not None:
+        layer_tree["l"] = lora
+
+    def body(x, layer):
+        out, kc, vc = _block_cached(
+            cfg, x, layer["p"], layer.get("l"), positions,
+            layer["k"], layer["v"], kv_len_mask)
+        return out, (kc, vc)
+
+    x, (new_k, new_v) = lax.scan(
+        body, x, dict(layer_tree, k=cache.k, v=cache.v))
+    x = _rms_norm(x, params["ln_f"], cfg.norm_eps)
+    unembed = params.get("unembed")
+    if unembed is None:
+        unembed = params["embed"].T
+    logits = jnp.einsum("bsh,hv->bsv", x, unembed.astype(x.dtype))
+    return logits, KVCache(new_k, new_v, cache.lengths)
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Reference surface: vLLM SamplingParams (the subset that matters)."""
+
+    max_tokens: int = 64
+    temperature: float = 0.0  # 0 = greedy
+    top_k: int = 0  # 0 = no top-k filter
+    stop_token_id: Optional[int] = None
+
+
+def _sample(logits, rng, temperature: float, top_k: int):
+    """logits [B,V] → token ids [B]."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, NEG_INF, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+class Generator:
+    """Compiled prefill + decode loop over one parameter set.
+
+    Built once per (batch, max_len) shape bucket; generate() runs
+    prompts → completions without recompiling.
+    """
+
+    def __init__(self, cfg: TransformerConfig, params, max_len: int = 512):
+        self.cfg = cfg
+        self.params = params
+        self.max_len = max_len
+        self._prefill = jax.jit(self._prefill_impl)
+        self._decode = jax.jit(
+            self._decode_impl, static_argnames=("temperature", "top_k"))
+
+    def _prefill_impl(self, params, tokens, lengths, cache):
+        b, s = tokens.shape
+        positions = jnp.arange(s)[None, :].repeat(b, 0)
+        kv_mask = jnp.arange(self.max_len)[None, :] < lengths[:, None]
+        logits, cache = forward_cached(
+            self.cfg, params, tokens, positions, cache, kv_mask)
+        # logits at each prompt's LAST real token
+        last = jnp.take_along_axis(
+            logits, (lengths - 1)[:, None, None].repeat(
+                logits.shape[-1], -1), axis=1)[:, 0]
+        return last, KVCache(cache.k, cache.v, lengths)
+
+    def _decode_impl(self, params, tok, cache, rng, *, temperature, top_k):
+        b = tok.shape[0]
+        positions = cache.lengths[:, None]  # next slot per sequence
+        kv_mask = jnp.arange(self.max_len)[None, :] <= cache.lengths[:, None]
+        logits, cache = forward_cached(
+            self.cfg, params, tok[:, None], positions, cache, kv_mask)
+        nxt = _sample(logits[:, 0], rng, temperature, top_k)
+        return nxt, KVCache(cache.k, cache.v, cache.lengths + 1)
+
+    def generate(self, prompts, sampling: Optional[SamplingParams] = None,
+                 seed: int = 0):
+        """prompts: list of int32 token-id lists → list of completions
+        (token-id lists, stop token excluded)."""
+        import numpy as np
+
+        sampling = sampling or SamplingParams()
+        b = len(prompts)
+        lens = np.array([len(p) for p in prompts], np.int32)
+        if int(lens.max()) >= self.max_len:
+            # JAX silently drops out-of-bounds cache scatters — without
+            # this check an over-long prompt would "generate" garbage
+            raise ValueError(
+                f"prompt length {int(lens.max())} >= max_len "
+                f"{self.max_len}; raise Generator(max_len=...)")
+        s = int(lens.max())
+        toks = np.zeros((b, s), np.int32)
+        for i, p in enumerate(prompts):
+            toks[i, : len(p)] = p
+        cache = init_cache(self.cfg, b, self.max_len)
+        last_logits, cache = self._prefill(
+            self.params, jnp.asarray(toks), jnp.asarray(lens), cache)
+        rng = jax.random.key(seed)
+        rng, k0 = jax.random.split(rng)
+        tok = _sample(last_logits, k0, sampling.temperature, sampling.top_k)
+        outs = [[] for _ in range(b)]
+        done = np.zeros(b, bool)
+        for _ in range(sampling.max_tokens):
+            tok_np = np.asarray(tok)
+            for i in range(b):
+                if not done[i]:
+                    if sampling.stop_token_id is not None and \
+                            int(tok_np[i]) == sampling.stop_token_id:
+                        done[i] = True
+                    else:
+                        outs[i].append(int(tok_np[i]))
+            # a sequence whose next KV slot is out of room stops alone —
+            # cache rows are per-sequence, so others keep decoding
+            lens_np = np.asarray(cache.lengths)
+            for i in range(b):
+                if not done[i] and lens_np[i] >= self.max_len:
+                    done[i] = True
+            if done.all():
+                break
+            rng, k = jax.random.split(rng)
+            tok, cache = self._decode(
+                self.params, tok, cache, k,
+                temperature=sampling.temperature, top_k=sampling.top_k)
+        return outs
+
+    def generate_stream(self, prompt, sampling: Optional[SamplingParams] = None,
+                        seed: int = 0):
+        """Single-prompt streaming: yields one token id at a time (the
+        Serve LLM deployment's token-stream path)."""
+        import numpy as np
+
+        sampling = sampling or SamplingParams()
+        prompt = list(prompt) or [0]
+        if len(prompt) >= self.max_len:
+            raise ValueError(
+                f"prompt length {len(prompt)} >= max_len {self.max_len}; "
+                f"raise Generator(max_len=...)")
+        toks = np.asarray([prompt], np.int32)
+        lens = np.asarray([len(prompt)], np.int32)
+        cache = init_cache(self.cfg, 1, self.max_len)
+        last_logits, cache = self._prefill(
+            self.params, jnp.asarray(toks), jnp.asarray(lens), cache)
+        rng = jax.random.key(seed)
+        rng, k0 = jax.random.split(rng)
+        tok = _sample(last_logits, k0, sampling.temperature, sampling.top_k)
+        for _ in range(sampling.max_tokens):
+            t = int(np.asarray(tok)[0])
+            if sampling.stop_token_id is not None and \
+                    t == sampling.stop_token_id:
+                return
+            yield t
+            if int(np.asarray(cache.lengths)[0]) >= self.max_len:
+                return
+            rng, k = jax.random.split(rng)
+            tok, cache = self._decode(
+                self.params, tok, cache, k,
+                temperature=sampling.temperature, top_k=sampling.top_k)

@@ -1,0 +1,36 @@
+"""Shared algorithm-config surface (reference:
+rllib/algorithms/algorithm_config.py `AlgorithmConfig`).
+
+The builder methods (environment / env_runners / training / build) are
+identical across PPO, DQN, SAC, and IMPALA — defined once here. Each
+concrete config dataclass inherits this and sets ``algo_cls`` after its
+algorithm class is defined.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+class AlgorithmConfigBase:
+    algo_cls: Any = None  # set by each algorithm module
+
+    def environment(self, env):
+        self.env = env
+        return self
+
+    def env_runners(self, num_env_runners: int,
+                    rollout_fragment_length: Optional[int] = None):
+        self.num_env_runners = num_env_runners
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kw):
+        for k, v in kw.items():
+            # "lambda" is a Python keyword; configs store it as lambda_
+            setattr(self, "lambda_" if k == "lambda" else k, v)
+        return self
+
+    def build(self):
+        return self.algo_cls(self)

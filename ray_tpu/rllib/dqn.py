@@ -15,6 +15,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 import ray_tpu
+from ray_tpu.rllib.algorithm import AlgorithmConfigBase
 from ray_tpu.rllib.env import make_env
 from ray_tpu.rllib.rollout import (
     ReplayBuffer, SampleRunner, init_mlp_params, mlp_apply,
@@ -31,7 +32,7 @@ def q_values(params, obs, n_hidden: int):
 
 
 @dataclasses.dataclass
-class DQNConfig:
+class DQNConfig(AlgorithmConfigBase):
     """Builder-style config (reference: DQNConfig, dqn.py)."""
 
     env: Any = "CartPole-v1"
@@ -50,25 +51,6 @@ class DQNConfig:
     double_q: bool = True
     hidden: Tuple[int, ...] = (64, 64)
     seed: int = 0
-
-    def environment(self, env) -> "DQNConfig":
-        self.env = env
-        return self
-
-    def env_runners(self, num_env_runners: int,
-                    rollout_fragment_length: Optional[int] = None) -> "DQNConfig":
-        self.num_env_runners = num_env_runners
-        if rollout_fragment_length:
-            self.rollout_fragment_length = rollout_fragment_length
-        return self
-
-    def training(self, **kw) -> "DQNConfig":
-        for k, v in kw.items():
-            setattr(self, k, v)
-        return self
-
-    def build(self) -> "DQN":
-        return DQN(self)
 
 
 class DQNLearner:
@@ -222,3 +204,6 @@ class DQN:
         self.learner.params = state["params"]
         self.learner.target_params = state["target"]
         self.learner.opt_state = state["opt_state"]
+
+
+DQNConfig.algo_cls = DQN

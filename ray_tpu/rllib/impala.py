@@ -21,6 +21,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 import ray_tpu
+from ray_tpu.rllib.algorithm import AlgorithmConfigBase
 from ray_tpu.rllib.env import make_env
 from ray_tpu.rllib.ppo import init_policy, policy_logits, value_fn
 from ray_tpu.rllib.rollout import SampleRunner
@@ -72,7 +73,7 @@ def vtrace_jax(values, next_values, rewards, discounts, rhos, cs,
 
 
 @dataclasses.dataclass
-class IMPALAConfig:
+class IMPALAConfig(AlgorithmConfigBase):
     """Builder-style config (reference: IMPALAConfig, impala.py)."""
 
     env: Any = "CartPole-v1"
@@ -88,24 +89,6 @@ class IMPALAConfig:
     hidden: Tuple[int, ...] = (64, 64)
     seed: int = 0
 
-    def environment(self, env) -> "IMPALAConfig":
-        self.env = env
-        return self
-
-    def env_runners(self, num_env_runners: int,
-                    rollout_fragment_length: Optional[int] = None) -> "IMPALAConfig":
-        self.num_env_runners = num_env_runners
-        if rollout_fragment_length:
-            self.rollout_fragment_length = rollout_fragment_length
-        return self
-
-    def training(self, **kw) -> "IMPALAConfig":
-        for k, v in kw.items():
-            setattr(self, k, v)
-        return self
-
-    def build(self) -> "IMPALA":
-        return IMPALA(self)
 
 
 class IMPALALearner:
@@ -282,3 +265,6 @@ class IMPALA:
         })
         self.learner.params = state["params"]
         self.learner.opt_state = state["opt_state"]
+
+
+IMPALAConfig.algo_cls = IMPALA

@@ -19,56 +19,40 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 import ray_tpu
+from ray_tpu.rllib.algorithm import AlgorithmConfigBase
 from ray_tpu.rllib.env import Env, make_env
 
 
 # ---------------------------------------------------------------------------
-# Policy/value network (pure jax)
+# Policy/value network (shared MLP definition, rollout.py)
 # ---------------------------------------------------------------------------
 def init_policy(key, obs_dim: int, num_actions: int, hidden: Tuple[int, ...] = (64, 64)):
     import jax
-    import jax.numpy as jnp
 
-    sizes = (obs_dim,) + hidden
-    keys = jax.random.split(key, len(sizes) * 2)
-    params = {"pi": {}, "vf": {}}
-    for net in ("pi", "vf"):
-        layers = {}
-        for i in range(len(sizes) - 1):
-            k = keys[i if net == "pi" else i + len(sizes)]
-            layers[f"w{i}"] = jax.random.normal(k, (sizes[i], sizes[i + 1])) * (
-                2.0 / sizes[i]
-            ) ** 0.5
-            layers[f"b{i}"] = jnp.zeros((sizes[i + 1],))
-        params[net] = layers
-    params["pi"]["head_w"] = jnp.zeros((sizes[-1], num_actions))
-    params["pi"]["head_b"] = jnp.zeros((num_actions,))
-    params["vf"]["head_w"] = jnp.zeros((sizes[-1], 1))
-    params["vf"]["head_b"] = jnp.zeros((1,))
-    return params
+    from ray_tpu.rllib.rollout import init_mlp_params
 
-
-def _mlp(layers: Dict, x, n_hidden: int):
-    import jax.numpy as jnp
-
-    for i in range(n_hidden):
-        x = jnp.tanh(x @ layers[f"w{i}"] + layers[f"b{i}"])
-    return x @ layers["head_w"] + layers["head_b"]
+    k_pi, k_vf = jax.random.split(key)
+    return {"pi": init_mlp_params(k_pi, obs_dim, hidden, num_actions),
+            "vf": init_mlp_params(k_vf, obs_dim, hidden, 1)}
 
 
 def policy_logits(params, obs, n_hidden: int = 2):
-    return _mlp(params["pi"], obs, n_hidden)
+    from ray_tpu.rllib.rollout import mlp_apply
+
+    return mlp_apply(params["pi"], obs, n_hidden)
 
 
 def value_fn(params, obs, n_hidden: int = 2):
-    return _mlp(params["vf"], obs, n_hidden)[..., 0]
+    from ray_tpu.rllib.rollout import mlp_apply
+
+    return mlp_apply(params["vf"], obs, n_hidden)[..., 0]
 
 
 # ---------------------------------------------------------------------------
 # Config
 # ---------------------------------------------------------------------------
 @dataclasses.dataclass
-class PPOConfig:
+class PPOConfig(AlgorithmConfigBase):
     """Reference: AlgorithmConfig + PPOConfig (ppo.py). Builder-style:
     PPOConfig().environment("CartPole-v1").env_runners(2).training(lr=3e-4)."""
 
@@ -86,23 +70,6 @@ class PPOConfig:
     hidden: Tuple[int, ...] = (64, 64)
     seed: int = 0
 
-    def environment(self, env) -> "PPOConfig":
-        self.env = env
-        return self
-
-    def env_runners(self, num_env_runners: int, rollout_fragment_length: Optional[int] = None) -> "PPOConfig":
-        self.num_env_runners = num_env_runners
-        if rollout_fragment_length:
-            self.rollout_fragment_length = rollout_fragment_length
-        return self
-
-    def training(self, **kw) -> "PPOConfig":
-        for k, v in kw.items():
-            setattr(self, k if k != "lambda" else "lambda_", v)
-        return self
-
-    def build(self) -> "PPO":
-        return PPO(self)
 
 
 # ---------------------------------------------------------------------------
@@ -123,21 +90,19 @@ class EnvRunner:
         self.completed_returns: List[float] = []
 
     def _value(self, obs, params_np: Dict) -> float:
-        v = obs
-        for i in range(self.n_hidden):
-            v = np.tanh(v @ params_np["vf"][f"w{i}"] + params_np["vf"][f"b{i}"])
-        return float((v @ params_np["vf"]["head_w"] + params_np["vf"]["head_b"])[0])
+        from ray_tpu.rllib.rollout import mlp_forward
+
+        return float(mlp_forward(params_np["vf"], obs, self.n_hidden)[0])
 
     def sample(self, params_np: Dict, num_steps: int) -> Dict[str, np.ndarray]:
         """Collect a fragment with the given policy weights (numpy inference
         on CPU — tiny nets; the TPU does the learning)."""
         obs_buf, act_buf, rew_buf, done_buf, logp_buf, val_buf = [], [], [], [], [], []
         trunc_buf, boot_buf = [], []
+        from ray_tpu.rllib.rollout import mlp_forward
+
         for _ in range(num_steps):
-            h = self.obs
-            for i in range(self.n_hidden):
-                h = np.tanh(h @ params_np["pi"][f"w{i}"] + params_np["pi"][f"b{i}"])
-            logits = h @ params_np["pi"]["head_w"] + params_np["pi"]["head_b"]
+            logits = mlp_forward(params_np["pi"], self.obs, self.n_hidden)
             z = logits - logits.max()
             p = np.exp(z) / np.exp(z).sum()
             a = int(self.rng.choice(len(p), p=p))
@@ -357,3 +322,6 @@ class PPO:
         )
         self.learner.params = state["params"]
         self.learner.opt_state = state["opt_state"]
+
+
+PPOConfig.algo_cls = PPO

@@ -17,6 +17,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 import ray_tpu
+from ray_tpu.rllib.algorithm import AlgorithmConfigBase
 from ray_tpu.rllib.env import make_env
 from ray_tpu.rllib.rollout import (
     ReplayBuffer, SampleRunner, init_mlp_params, mlp_apply as _mlp,
@@ -24,7 +25,7 @@ from ray_tpu.rllib.rollout import (
 
 
 @dataclasses.dataclass
-class SACConfig:
+class SACConfig(AlgorithmConfigBase):
     """Builder-style config (reference: SACConfig, sac.py)."""
 
     env: Any = "CartPole-v1"
@@ -42,24 +43,6 @@ class SACConfig:
     hidden: Tuple[int, ...] = (64, 64)
     seed: int = 0
 
-    def environment(self, env) -> "SACConfig":
-        self.env = env
-        return self
-
-    def env_runners(self, num_env_runners: int,
-                    rollout_fragment_length: Optional[int] = None) -> "SACConfig":
-        self.num_env_runners = num_env_runners
-        if rollout_fragment_length:
-            self.rollout_fragment_length = rollout_fragment_length
-        return self
-
-    def training(self, **kw) -> "SACConfig":
-        for k, v in kw.items():
-            setattr(self, k, v)
-        return self
-
-    def build(self) -> "SAC":
-        return SAC(self)
 
 
 class SACLearner:
@@ -247,3 +230,6 @@ class SAC:
         self.learner.params = state["params"]
         self.learner.target = state["target"]
         self.learner.opt_state = state["opt_state"]
+
+
+SACConfig.algo_cls = SAC
