@@ -42,6 +42,10 @@ class NodeInfo:
     last_heartbeat: float = field(default_factory=time.monotonic)
     is_head: bool = False
     labels: Dict[str, str] = field(default_factory=dict)
+    # autoscaler signal (reference: GcsAutoscalerStateManager)
+    pending_shapes: List[Dict[str, float]] = field(default_factory=list)
+    num_leases: int = 0
+    idle_since: Optional[float] = None
 
 
 @dataclass
@@ -67,6 +71,9 @@ class ActorInfo:
     # num_cpus defaulted: CPU counts for scheduling creation only, not held
     # while alive (reference actor resource semantics)
     cpu_scheduling_only: bool = False
+    # a lease request for this actor is queued at some raylet — its shape
+    # already shows in that node's pending_shapes (autoscaler dedupe)
+    lease_in_flight: bool = False
 
 
 @dataclass
@@ -109,6 +116,7 @@ class GcsServer:
         self.pubsub: Dict[str, Any] = {}
         self._pubsub_seq = 0
         self._pubsub_waiters: Any = None  # asyncio.Condition, lazy
+        self.autoscaler_enabled = False
         self._load_persisted()
         self.server.register_instance(self)
 
@@ -165,19 +173,38 @@ class GcsServer:
         return {"ok": True}
 
     async def Heartbeat(
-        self, node_id: str, available_resources: Dict[str, float]
+        self, node_id: str, available_resources: Dict[str, float],
+        pending_shapes: Optional[List[Dict[str, float]]] = None,
+        num_leases: int = 0,
     ) -> dict:
         node = self.nodes.get(node_id)
         if node is None:
             return {"ok": False, "reregister": True}
         node.last_heartbeat = time.monotonic()
         node.available_resources = dict(available_resources)
+        node.pending_shapes = list(pending_shapes or [])
+        node.num_leases = num_leases
+        # idle tracking for scale-down: a node is idle when it holds no
+        # leases and has no queued demand
+        if num_leases == 0 and not node.pending_shapes:
+            if node.idle_since is None:
+                node.idle_since = time.monotonic()
+        else:
+            node.idle_since = None
         if not node.alive:
             node.alive = True
             self._node_version += 1
         # piggyback the cluster resource view so raylets can spill leases
         # to other nodes (reference: ray_syncer.h:91 resource broadcast)
-        return {"ok": True, "cluster": self._cluster_view()}
+        return {"ok": True, "cluster": self._cluster_view(),
+                "autoscaling": self.autoscaler_enabled}
+
+    async def SetAutoscalerEnabled(self, enabled: bool) -> dict:
+        """An attached autoscaler flips lease semantics: locally
+        infeasible requests queue (visible as demand) instead of failing
+        (reference: infeasible tasks wait for the autoscaler)."""
+        self.autoscaler_enabled = bool(enabled)
+        return {"ok": True}
 
     def _cluster_view(self) -> Dict[str, dict]:
         return {
@@ -188,6 +215,37 @@ class GcsServer:
                 "available": dict(n.available_resources),
             }
             for n in self.nodes.values()
+        }
+
+    async def GetClusterDemand(self) -> dict:
+        """Autoscaler input (reference: autoscaler/v2 reads
+        GcsAutoscalerStateManager): per-node availability, queued lease
+        shapes, pending (unschedulable) actors, and idle times."""
+        now = time.monotonic()
+        pending_actors = [
+            dict(a.resources)
+            for a in self.actors.values()
+            # lease_in_flight actors already appear in some raylet's
+            # pending_shapes — counting both would double the demand
+            if a.state == "PENDING" and not a.lease_in_flight
+        ]
+        return {
+            "nodes": [
+                {
+                    "node_id": n.node_id,
+                    "alive": n.alive,
+                    "is_head": n.is_head,
+                    "total": dict(n.total_resources),
+                    "available": dict(n.available_resources),
+                    "pending_shapes": list(n.pending_shapes),
+                    "num_leases": n.num_leases,
+                    "idle_s": (now - n.idle_since)
+                    if n.idle_since is not None else 0.0,
+                    "labels": dict(n.labels),
+                }
+                for n in self.nodes.values()
+            ],
+            "pending_actors": pending_actors,
         }
 
     async def DrainNode(self, node_id: str) -> dict:
@@ -400,18 +458,22 @@ class GcsServer:
                 continue
             try:
                 raylet = self._raylet(node_id)
-                reply = await raylet.acall(
-                    "RequestWorkerLease",
-                    resources=actor.resources,
-                    scheduling_class=("actor", actor.actor_id),
-                    job_id=actor.job_id,
-                    for_actor=actor.actor_id,
-                    pg_id=actor.pg_id,
-                    bundle_index=actor.bundle_index,
-                    lease_timeout=50.0,
-                    release_cpu_after_grant=actor.cpu_scheduling_only,
-                    timeout=60,
-                )
+                actor.lease_in_flight = True
+                try:
+                    reply = await raylet.acall(
+                        "RequestWorkerLease",
+                        resources=actor.resources,
+                        scheduling_class=("actor", actor.actor_id),
+                        job_id=actor.job_id,
+                        for_actor=actor.actor_id,
+                        pg_id=actor.pg_id,
+                        bundle_index=actor.bundle_index,
+                        lease_timeout=50.0,
+                        release_cpu_after_grant=actor.cpu_scheduling_only,
+                        timeout=60,
+                    )
+                finally:
+                    actor.lease_in_flight = False
             except Exception as e:  # noqa: BLE001
                 logger.warning("actor %s lease request to %s failed: %s", actor.actor_id[:12], node_id[:12], e)
                 await asyncio.sleep(0.5)

@@ -104,6 +104,7 @@ def spawn_raylet(
     session_dir: str,
     is_head: bool = False,
     log_name: str = "raylet.log",
+    labels: Optional[Dict[str, str]] = None,
 ) -> Tuple[subprocess.Popen, int]:
     """Spawn a raylet daemon process and wait for its port file.
 
@@ -133,6 +134,8 @@ def spawn_raylet(
     ]
     if is_head:
         cmd.append("--is-head")
+    if labels:
+        cmd.extend(["--labels-json", json.dumps(labels)])
     proc = subprocess.Popen(cmd, env=env, stdout=raylet_log, stderr=subprocess.STDOUT)
     deadline = time.monotonic() + 30
     while not os.path.exists(port_file):

@@ -134,6 +134,7 @@ class Raylet:
         self.idle_workers: List[WorkerHandle] = []
         self.leases: Dict[str, Lease] = {}
         self.pending: List[PendingLease] = []
+        self.autoscaling_enabled = False
         # placement group bundles: (pg_id, bundle_index) -> alloc
         self.prepared_bundles: Dict[Tuple[str, int], Dict[str, Any]] = {}
         self.committed_bundles: Dict[Tuple[str, int], "ResourceSet"] = {}
@@ -300,12 +301,18 @@ class Raylet:
                 target = await self._await_spillback(req["resources"], grace)
                 if target is not None:
                     return {"granted": False, "spillback": target}
-            return {
-                "granted": False,
-                "infeasible": True,
-                "error": f"resources {resources} can never be satisfied on this node "
-                f"(total: {rs.total})",
-            }
+            if not self.autoscaling_enabled:
+                return {
+                    "granted": False,
+                    "infeasible": True,
+                    "error": f"resources {resources} can never be satisfied on this node "
+                    f"(total: {rs.total})",
+                }
+            # An attached autoscaler may add a node that fits: queue the
+            # request so its shape shows up as demand in heartbeats
+            # (reference: infeasible tasks wait for the autoscaler); the
+            # caller's retry-after-timeout picks up the new node via
+            # spillback.
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         pl = PendingLease(req, fut)
         self.pending.append(pl)
@@ -529,6 +536,24 @@ class Raylet:
                 continue
             grant = await self._try_grant(p.request)
             if grant is None:
+                # a queued request this node can NEVER serve (it sits here
+                # as autoscaler demand) redirects the moment a fitting node
+                # appears in the cluster view — without this, the caller
+                # only reaches a fresh node after its full lease timeout,
+                # and the autoscaler sees the new node as idle and kills
+                # it (scale-up/terminate flapping)
+                rs, _ = self._resource_set_for(p.request)
+                if not p.request.get("pg_id") and \
+                        not rs.feasible(p.request["resources"]):
+                    target = self._pick_spillback(
+                        p.request["resources"], require_available=False)
+                    if target is not None and not p.future.done():
+                        try:
+                            p.future.set_result(
+                                {"granted": False, "spillback": target})
+                        except asyncio.InvalidStateError:
+                            pass
+                        continue
                 still.append(p)
                 continue
             # the future may have been cancelled (requester timeout) while
@@ -861,10 +886,16 @@ class Raylet:
         period = config.raylet_heartbeat_period_ms / 1000.0
         while True:
             try:
+                # pending lease shapes feed the autoscaler's bin-packing
+                # (reference: GcsAutoscalerStateManager demand aggregation)
+                shapes = [dict(p.request.get("resources") or {})
+                          for p in self.pending[:100]]
                 reply = await self.gcs.acall(
                     "Heartbeat",
                     node_id=self.node_id,
                     available_resources=self.resources.available,
+                    pending_shapes=shapes,
+                    num_leases=len(self.leases),
                     timeout=10,
                 )
                 if reply.get("reregister"):
@@ -872,6 +903,9 @@ class Raylet:
                 view = reply.get("cluster")
                 if view:
                     self.cluster_view = view
+                if "autoscaling" in reply:
+                    # absent on reregister replies — don't flip to False
+                    self.autoscaling_enabled = bool(reply["autoscaling"])
             except Exception as e:  # noqa: BLE001
                 logger.warning("heartbeat failed: %s", e)
             await asyncio.sleep(period)
@@ -1050,6 +1084,7 @@ def main() -> None:
     parser.add_argument("--session-dir", default="")
     parser.add_argument("--port-file", default="")
     parser.add_argument("--log-level", default="INFO")
+    parser.add_argument("--labels-json", default="")
     args = parser.parse_args()
     logging.basicConfig(level=args.log_level, format="[raylet] %(levelname)s %(message)s")
 
@@ -1081,6 +1116,7 @@ def main() -> None:
         port=args.port,
         is_head=args.is_head,
         session_dir=args.session_dir,
+        labels=json.loads(args.labels_json) if args.labels_json else None,
     )
 
     async def _run():

@@ -1,0 +1,30 @@
+"""ray_tpu.autoscaler — demand-driven cluster scaling (reference:
+python/ray/autoscaler/v2).
+
+The GCS aggregates queued lease shapes from raylet heartbeats plus
+pending actors; the autoscaler bin-packs unmet demand onto configured
+node types and drives a NodeProvider. TPU pod slices scale atomically
+(slice_hosts hosts per unit, terminated only when every host is idle).
+"""
+
+from ray_tpu.autoscaler.autoscaler import (
+    Autoscaler,
+    NodeTypeConfig,
+    compute_scaling_decision,
+)
+from ray_tpu.autoscaler.node_provider import (
+    FakeNodeProvider,
+    GCETpuNodeProvider,
+    LocalNodeProvider,
+    NodeProvider,
+)
+
+__all__ = [
+    "Autoscaler",
+    "FakeNodeProvider",
+    "GCETpuNodeProvider",
+    "LocalNodeProvider",
+    "NodeProvider",
+    "NodeTypeConfig",
+    "compute_scaling_decision",
+]

@@ -1,0 +1,172 @@
+"""Node providers — how the autoscaler acquires/releases machines.
+
+Reference: python/ray/autoscaler/node_provider.py (`NodeProvider` ABC)
+and autoscaler/v2/instance_manager/cloud_providers/. Three providers:
+
+- ``FakeNodeProvider`` — in-memory bookkeeping for unit tests.
+- ``LocalNodeProvider`` — spawns REAL raylet daemons on this machine,
+  registering with a live GCS (the cluster_utils.Cluster mechanism) —
+  the end-to-end test path and the single-host dev story.
+- ``GCETpuNodeProvider`` — shells out to gcloud for TPU VMs; slice
+  creation/deletion is atomic at the queued-resource level. Requires a
+  GCP environment; methods raise a clear error when gcloud is absent.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+from typing import Dict, List, Optional, Tuple
+
+
+class NodeProvider:
+    """Minimal provider contract. ``create_node`` returns provider node
+    ids — for TPU slice types one create call may return SEVERAL host
+    nodes (the slice is atomic: all hosts or none)."""
+
+    def create_node(self, node_type: str, node_config: dict,
+                    labels: Dict[str, str]) -> List[str]:
+        raise NotImplementedError
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> Dict[str, str]:
+        """provider_node_id -> node_type"""
+        raise NotImplementedError
+
+
+class FakeNodeProvider(NodeProvider):
+    def __init__(self):
+        self.launches: List[Tuple[str, dict]] = []
+        self.terminated: List[str] = []
+        self._nodes: Dict[str, str] = {}
+        self._n = 0
+
+    def create_node(self, node_type, node_config, labels):
+        count = int(node_config.get("slice_hosts", 1))
+        ids = []
+        for _ in range(count):
+            self._n += 1
+            nid = f"fake-{node_type}-{self._n}"
+            self._nodes[nid] = node_type
+            ids.append(nid)
+        self.launches.append((node_type, dict(node_config)))
+        return ids
+
+    def terminate_node(self, provider_node_id):
+        self._nodes.pop(provider_node_id, None)
+        self.terminated.append(provider_node_id)
+
+    def non_terminated_nodes(self):
+        return dict(self._nodes)
+
+
+class LocalNodeProvider(NodeProvider):
+    """Real raylet daemons joining an existing GCS — provider node id ==
+    raylet node id, so the autoscaler can match GCS state directly."""
+
+    def __init__(self, gcs_addr: Tuple[str, int],
+                 session_dir: Optional[str] = None):
+        self.gcs_addr = tuple(gcs_addr)
+        self.session_dir = session_dir or tempfile.mkdtemp(
+            prefix="ray_tpu_autoscaler_")
+        self._nodes: Dict[str, Tuple[subprocess.Popen, str]] = {}
+
+    def create_node(self, node_type, node_config, labels):
+        from ray_tpu._private.config import config
+        from ray_tpu._private.ids import NodeID
+        from ray_tpu._private.node import spawn_raylet
+
+        count = int(node_config.get("slice_hosts", 1))
+        ids = []
+        for _ in range(count):
+            node_id = NodeID.from_random().hex()
+            node_dir = os.path.join(self.session_dir,
+                                    f"as-{node_type}-{node_id[:8]}")
+            os.makedirs(node_dir, exist_ok=True)
+            res = dict(node_config.get("resources") or {"CPU": 1.0})
+            res.setdefault("memory", 1 * 1024**3)
+            proc, _port = spawn_raylet(
+                gcs_addr=self.gcs_addr,
+                node_id=node_id,
+                resources=res,
+                store_socket=os.path.join(node_dir, "store.sock"),
+                store_capacity=int(
+                    node_config.get("object_store_memory")
+                    or config.object_store_memory_bytes),
+                session_dir=node_dir,
+                is_head=False,
+                labels=dict(labels),
+            )
+            self._nodes[node_id] = (proc, node_type)
+            ids.append(node_id)
+        return ids
+
+    def terminate_node(self, provider_node_id):
+        from ray_tpu._private.node import kill_process_tree
+
+        ent = self._nodes.pop(provider_node_id, None)
+        if ent is not None:
+            kill_process_tree(ent[0])
+
+    def non_terminated_nodes(self):
+        return {nid: t for nid, (p, t) in self._nodes.items()
+                if p.poll() is None}
+
+    def shutdown(self) -> None:
+        for nid in list(self._nodes):
+            self.terminate_node(nid)
+
+
+class GCETpuNodeProvider(NodeProvider):
+    """TPU-VM provider via gcloud (reference: autoscaler/_private/gcp/
+    node_provider.py + the TPU queued-resources API). A node type whose
+    config carries ``accelerator_type`` (e.g. "v5litepod-16") maps to
+    ONE TPU slice; create/delete operate on whole slices — hosts of a
+    slice never scale independently (SURVEY.md §7 'slice-granular gang
+    scheduling')."""
+
+    def __init__(self, project: str, zone: str, prefix: str = "ray-tpu"):
+        self.project = project
+        self.zone = zone
+        self.prefix = prefix
+        self._n = 0
+
+    def _gcloud(self, *args: str) -> str:
+        try:
+            return subprocess.check_output(
+                ("gcloud",) + args, text=True,
+                stderr=subprocess.STDOUT)
+        except FileNotFoundError as e:
+            raise RuntimeError(
+                "gcloud CLI not available — GCETpuNodeProvider needs a "
+                "GCP environment") from e
+
+    def create_node(self, node_type, node_config, labels):
+        self._n += 1
+        name = f"{self.prefix}-{node_type}-{self._n}"
+        acc = node_config["accelerator_type"]
+        self._gcloud(
+            "compute", "tpus", "tpu-vm", "create", name,
+            f"--project={self.project}", f"--zone={self.zone}",
+            f"--accelerator-type={acc}",
+            f"--version={node_config.get('runtime_version', 'tpu-ubuntu2204-base')}",
+        )
+        return [name]
+
+    def terminate_node(self, provider_node_id):
+        self._gcloud(
+            "compute", "tpus", "tpu-vm", "delete", provider_node_id,
+            f"--project={self.project}", f"--zone={self.zone}", "--quiet",
+        )
+
+    def non_terminated_nodes(self):
+        out = self._gcloud(
+            "compute", "tpus", "tpu-vm", "list",
+            f"--project={self.project}", f"--zone={self.zone}",
+            "--format=value(name)",
+        )
+        return {n: n.split("-")[2] if n.count("-") >= 2 else "tpu"
+                for n in out.split() if n.startswith(self.prefix)}

@@ -1,0 +1,175 @@
+"""Autoscaler tests (reference: autoscaler/v2 tests): pure bin-packing
+decisions with a fake provider, then real end-to-end scale-up/down with
+LocalNodeProvider launching actual raylet daemons."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (
+    Autoscaler,
+    FakeNodeProvider,
+    LocalNodeProvider,
+    NodeTypeConfig,
+    compute_scaling_decision,
+)
+
+
+def _demand(nodes=None, pending_actors=None):
+    return {"nodes": nodes or [], "pending_actors": pending_actors or []}
+
+
+def _node(nid, avail, total=None, pending=None, idle_s=0.0, head=False):
+    return {
+        "node_id": nid, "alive": True, "is_head": head,
+        "total": total or dict(avail), "available": avail,
+        "pending_shapes": pending or [], "num_leases": 0,
+        "idle_s": idle_s, "labels": {},
+    }
+
+
+TYPES = {
+    "cpu4": NodeTypeConfig(resources={"CPU": 4.0}, max_workers=5),
+    "tpu_v5e_4": NodeTypeConfig(
+        resources={"CPU": 8.0, "TPU": 4.0}, max_workers=2, slice_hosts=2),
+}
+
+
+class TestDecision:
+    def test_no_demand_no_launch(self):
+        launch, term = compute_scaling_decision(
+            _demand([_node("head", {"CPU": 2}, head=True)]), TYPES, {})
+        assert launch == {} and term == []
+
+    def test_unmet_demand_launches_smallest_fitting_type(self):
+        d = _demand([_node("head", {"CPU": 0.0}, total={"CPU": 1.0},
+                           pending=[{"CPU": 2.0}])])
+        launch, _ = compute_scaling_decision(d, TYPES, {})
+        assert launch == {"cpu4": 1}
+
+    def test_demand_packs_onto_one_new_node(self):
+        # four 1-CPU shapes fit one cpu4 node
+        d = _demand([_node("head", {"CPU": 0.0},
+                           pending=[{"CPU": 1.0}] * 4)])
+        launch, _ = compute_scaling_decision(d, TYPES, {})
+        assert launch == {"cpu4": 1}
+
+    def test_max_workers_bounds_launches(self):
+        d = _demand([_node("head", {"CPU": 0.0},
+                           pending=[{"CPU": 4.0}] * 10)])
+        launch, _ = compute_scaling_decision(d, TYPES, {"cpu4": 3})
+        assert launch["cpu4"] == 2  # 3 live + 2 = max 5
+
+    def test_tpu_shape_launches_slice(self):
+        d = _demand([_node("head", {"CPU": 1.0},
+                           pending=[{"TPU": 4.0}])])
+        launch, _ = compute_scaling_decision(d, TYPES, {})
+        assert launch == {"tpu_v5e_4": 1}
+
+    def test_min_workers_enforced(self):
+        types = {"cpu4": NodeTypeConfig(resources={"CPU": 4.0},
+                                        min_workers=2, max_workers=5)}
+        launch, _ = compute_scaling_decision(_demand(), types, {})
+        assert launch == {"cpu4": 2}
+
+    def test_available_capacity_absorbs_demand(self):
+        d = _demand([_node("head", {"CPU": 8.0}, pending=[{"CPU": 2.0}])])
+        launch, _ = compute_scaling_decision(d, TYPES, {})
+        assert launch == {}
+
+    def test_idle_termination_spares_head_and_busy(self):
+        d = _demand([
+            _node("head", {"CPU": 4}, idle_s=999, head=True),
+            _node("w1", {"CPU": 4}, idle_s=999),
+            _node("w2", {"CPU": 2}, idle_s=1.0),
+        ])
+        _, term = compute_scaling_decision(d, TYPES, {}, idle_timeout_s=60)
+        assert term == ["w1"]
+
+    def test_slice_terminates_whole_or_not_at_all(self):
+        d = _demand([
+            _node("head", {"CPU": 4}, head=True),
+            _node("s1a", {"TPU": 4}, idle_s=999),
+            _node("s1b", {"TPU": 4}, idle_s=5.0),  # one busy host pins it
+            _node("s2a", {"TPU": 4}, idle_s=999),
+            _node("s2b", {"TPU": 4}, idle_s=999),
+        ])
+        _, term = compute_scaling_decision(
+            d, TYPES, {}, idle_timeout_s=60,
+            node_slices={"s1a": "sl1", "s1b": "sl1",
+                         "s2a": "sl2", "s2b": "sl2"})
+        assert sorted(term) == ["s2a", "s2b"]
+
+    def test_min_workers_held_through_idle_termination(self):
+        types = {"cpu4": NodeTypeConfig(resources={"CPU": 4.0},
+                                        min_workers=1, max_workers=5)}
+        d = _demand([
+            _node("head", {"CPU": 4}, head=True),
+            _node("w1", {"CPU": 4}, idle_s=999),
+            _node("w2", {"CPU": 4}, idle_s=999),
+        ])
+        _, term = compute_scaling_decision(
+            d, types, {"cpu4": 2}, idle_timeout_s=60,
+            node_type_map={"w1": "cpu4", "w2": "cpu4"})
+        assert len(term) == 1  # one stays: min_workers=1
+
+    def test_pending_actor_counts_as_demand(self):
+        d = _demand([_node("head", {"CPU": 0.0})],
+                    pending_actors=[{"CPU": 3.0}])
+        launch, _ = compute_scaling_decision(d, TYPES, {})
+        assert launch == {"cpu4": 1}
+
+
+class TestFakeProviderLoop:
+    def test_slice_launch_is_atomic(self):
+        p = FakeNodeProvider()
+        ids = p.create_node("tpu", {"slice_hosts": 4}, {})
+        assert len(ids) == 4
+        assert len(p.non_terminated_nodes()) == 4
+
+
+@pytest.mark.timeout(300)
+class TestEndToEnd:
+    def test_scale_up_then_down(self):
+        """Real flow: demand the head can't serve → autoscaler launches a
+        real raylet → tasks run there → idle node is terminated."""
+        from ray_tpu.cluster_utils import Cluster
+
+        cluster = Cluster()
+        cluster.add_node(num_cpus=1)
+        cluster.wait_for_nodes()
+        provider = LocalNodeProvider(cluster.gcs_addr)
+        asc = Autoscaler(
+            cluster.gcs_addr,
+            {"cpu2": NodeTypeConfig(resources={"CPU": 2.0}, max_workers=2)},
+            provider, idle_timeout_s=6.0, interval_s=1.0,
+        )
+        try:
+            ray_tpu.init(address=cluster.address)
+
+            @ray_tpu.remote(num_cpus=2)
+            def big(x):
+                return x * 10
+
+            futs = [big.remote(i) for i in range(3)]
+            asc.start()
+            out = ray_tpu.get(futs, timeout=180)
+            assert out == [0, 10, 20]
+            assert asc.num_launches >= 1
+            # scale-down: every launched node ends up idle-terminated
+            # (nodes pass the idle threshold on different reconcile rounds)
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline and \
+                    provider.non_terminated_nodes():
+                time.sleep(1.0)
+            assert asc.num_terminations >= 1
+            assert provider.non_terminated_nodes() == {}
+        finally:
+            asc.stop()
+            try:
+                ray_tpu.shutdown()
+            except Exception:
+                pass
+            provider.shutdown()
+            cluster.shutdown()
