@@ -116,7 +116,10 @@ class GcsServer:
         self.pubsub: Dict[str, Any] = {}
         self._pubsub_seq = 0
         self._pubsub_waiters: Any = None  # asyncio.Condition, lazy
-        self.autoscaler_enabled = False
+        # lease, not a latch: the autoscaler re-asserts every reconcile
+        # round; if it dies, the flag expires and raylets fall back to
+        # fail-fast infeasible errors instead of queueing forever
+        self.autoscaler_enabled_until = 0.0
         self._load_persisted()
         self.server.register_instance(self)
 
@@ -197,13 +200,17 @@ class GcsServer:
         # piggyback the cluster resource view so raylets can spill leases
         # to other nodes (reference: ray_syncer.h:91 resource broadcast)
         return {"ok": True, "cluster": self._cluster_view(),
-                "autoscaling": self.autoscaler_enabled}
+                "autoscaling":
+                    time.monotonic() < self.autoscaler_enabled_until}
 
-    async def SetAutoscalerEnabled(self, enabled: bool) -> dict:
+    async def SetAutoscalerEnabled(self, enabled: bool,
+                                   ttl_s: float = 30.0) -> dict:
         """An attached autoscaler flips lease semantics: locally
         infeasible requests queue (visible as demand) instead of failing
-        (reference: infeasible tasks wait for the autoscaler)."""
-        self.autoscaler_enabled = bool(enabled)
+        (reference: infeasible tasks wait for the autoscaler). The flag
+        is a TTL lease the autoscaler renews each reconcile round."""
+        self.autoscaler_enabled_until = \
+            (time.monotonic() + ttl_s) if enabled else 0.0
         return {"ok": True}
 
     def _cluster_view(self) -> Dict[str, dict]:

@@ -60,6 +60,7 @@ def compute_scaling_decision(
     idle_timeout_s: float = 60.0,
     node_slices: Optional[Dict[str, str]] = None,
     node_type_map: Optional[Dict[str, str]] = None,
+    booting: Optional[Dict[str, int]] = None,
 ) -> Tuple[Dict[str, int], List[str]]:
     """Pure decision function (unit-testable without a cluster).
 
@@ -67,10 +68,15 @@ def compute_scaling_decision(
     node type (in slice units for slice types). node_slices: node_id →
     slice_id for slice-grouped termination. node_type_map: node_id →
     node type, used to hold min_workers through idle termination.
+    booting: units per type already launched but not yet registered in
+    the GCS — their capacity is credited so each reconcile round doesn't
+    re-launch for the same demand (reference: the v2 instance manager
+    tracks pending instances).
     Returns (launch: {type: units}, terminate: [node_ids]).
     """
     node_slices = node_slices or {}
     node_type_map = node_type_map or {}
+    booting = booting or {}
     nodes = [n for n in demand.get("nodes", []) if n.get("alive")]
     shapes: List[Dict[str, float]] = []
     for n in nodes:
@@ -91,9 +97,16 @@ def compute_scaling_decision(
         else:
             unmet.append(s)
 
-    # 2) pack the unmet remainder onto hypothetical new nodes
+    # 2) pack the unmet remainder onto hypothetical new nodes; nodes
+    # still booting count as capacity first
     launch: Dict[str, int] = {}
     pending_avails: List[Dict[str, float]] = []
+    for tname, units in booting.items():
+        tc = node_types.get(tname)
+        if tc is None:
+            continue
+        for _ in range(units * tc.slice_hosts):
+            pending_avails.append(dict(tc.resources))
     for s in unmet:
         placed = False
         for a in pending_avails:
@@ -189,6 +202,8 @@ class Autoscaler:
         self.interval_s = interval_s
         # provider_node_id -> (node_type, slice_id)
         self._launched: Dict[str, Tuple[str, str]] = {}
+        self._launch_times: Dict[str, float] = {}
+        self.boot_grace_s = 120.0  # credit booting nodes this long
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.num_launches = 0
@@ -202,9 +217,11 @@ class Autoscaler:
 
     # -- one reconcile step -------------------------------------------
     def update(self) -> Tuple[Dict[str, int], List[str]]:
-        # re-assert each round: survives a GCS restart losing the flag
+        # renew the TTL lease each round: survives a GCS restart losing
+        # the flag, and expires if this autoscaler dies
         try:
-            self.gcs.call("SetAutoscalerEnabled", enabled=True, timeout=5)
+            self.gcs.call("SetAutoscalerEnabled", enabled=True,
+                          ttl_s=max(30.0, 3 * self.interval_s), timeout=5)
         except Exception:  # noqa: BLE001
             pass
         demand = self.gcs.call("GetClusterDemand", timeout=10)
@@ -235,10 +252,28 @@ class Autoscaler:
             for nid, n in gcs_nodes.items()
             if n.get("labels", {}).get("node_type")
         }
+        # nodes we launched that haven't registered in the GCS yet count
+        # as booting capacity (until a grace period expires — a node that
+        # never comes up stops blocking launches)
+        now = time.monotonic()
+        booting: Dict[str, int] = {}
+        booting_sids: Dict[str, set] = {}
+        for nid, (tname, sid) in self._launched.items():
+            if nid in gcs_nodes:
+                continue
+            if now - self._launch_times.get(nid, 0.0) > self.boot_grace_s:
+                continue
+            tc = self.node_types.get(tname)
+            if tc and tc.slice_hosts > 1:
+                booting_sids.setdefault(tname, set()).add(sid)
+            else:
+                booting[tname] = booting.get(tname, 0) + 1
+        for tname, sids in booting_sids.items():
+            booting[tname] = booting.get(tname, 0) + len(sids)
         launch, terminate = compute_scaling_decision(
             demand, self.node_types, type_counts,
             idle_timeout_s=self.idle_timeout_s, node_slices=node_slices,
-            node_type_map=node_type_map)
+            node_type_map=node_type_map, booting=booting)
         for tname, units in launch.items():
             tc = self.node_types[tname]
             for _ in range(units):
@@ -249,30 +284,39 @@ class Autoscaler:
                     tname, cfg, labels={"node_type": tname, "slice_id": sid})
                 for nid in ids:
                     self._launched[nid] = (tname, sid)
+                    self._launch_times[nid] = time.monotonic()
                 self.num_launches += 1
                 logger.info("launched %s x1 (%d hosts): %s",
                             tname, len(ids), ids)
         killed: set = set()
+        killed_sids: set = set()
         for nid in terminate:
             # resolve the GCS node to provider node(s): direct id match
             # (LocalNodeProvider) or via the slice_id label (cloud
             # providers whose ids are VM names)
+            sid = gcs_nodes.get(nid, {}).get("labels", {}).get("slice_id")
             if nid in self._launched:
                 pids = [nid]
             else:
-                sid = gcs_nodes.get(nid, {}).get("labels", {}).get("slice_id")
                 pids = [p for p, (_t, s) in self._launched.items()
                         if sid and s == sid]
             pids = [p for p in pids if p not in killed]
-            if not pids:
+            if not pids and not (sid and sid in killed_sids):
                 continue  # not ours (e.g. manually added node)
+            # drain EVERY GCS member of a terminated slice, including
+            # those whose provider host was already destroyed by an
+            # earlier iteration — otherwise the cluster view keeps
+            # spilling leases to a dead host until heartbeat timeout
             try:
                 self.gcs.call("DrainNode", node_id=nid, timeout=5)
             except Exception:  # noqa: BLE001
                 pass
+            if sid:
+                killed_sids.add(sid)
             for pid in pids:
                 self.provider.terminate_node(pid)
                 self._launched.pop(pid, None)
+                self._launch_times.pop(pid, None)
                 killed.add(pid)
                 self.num_terminations += 1
                 logger.info("terminated idle node %s", str(pid)[:12])

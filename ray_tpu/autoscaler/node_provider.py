@@ -126,12 +126,22 @@ class GCETpuNodeProvider(NodeProvider):
     config carries ``accelerator_type`` (e.g. "v5litepod-16") maps to
     ONE TPU slice; create/delete operate on whole slices — hosts of a
     slice never scale independently (SURVEY.md §7 'slice-granular gang
-    scheduling')."""
+    scheduling').
 
-    def __init__(self, project: str, zone: str, prefix: str = "ray-tpu"):
+    ``head_address`` (GCS host:port reachable from the VMs) is required
+    for the VM to JOIN the cluster: a startup script runs
+    ``ray-tpu start --address`` on every host with the launch labels, so
+    the raylets register carrying node_type/slice_id — the autoscaler's
+    join key for matching GCS nodes back to VMs. ``setup_command``
+    prepends e.g. a pip install of this package."""
+
+    def __init__(self, project: str, zone: str, head_address: str,
+                 prefix: str = "ray-tpu", setup_command: str = ""):
         self.project = project
         self.zone = zone
+        self.head_address = head_address
         self.prefix = prefix
+        self.setup_command = setup_command
         self._n = 0
 
     def _gcloud(self, *args: str) -> str:
@@ -144,6 +154,24 @@ class GCETpuNodeProvider(NodeProvider):
                 "gcloud CLI not available — GCETpuNodeProvider needs a "
                 "GCP environment") from e
 
+    def _startup_script(self, node_config: dict,
+                        labels: Dict[str, str]) -> str:
+        import json as _json
+        import shlex
+
+        resources = node_config.get("resources") or {}
+        return "\n".join([
+            "#! /bin/bash",
+            self.setup_command,
+            "python3 -m ray_tpu.scripts.scripts start "
+            f"--address {shlex.quote(self.head_address)} "
+            f"--labels {shlex.quote(_json.dumps(labels))} "
+            + (f"--num-cpus {resources['CPU']} "
+               if resources.get("CPU") else "")
+            + (f"--num-tpus {resources['TPU']}"
+               if resources.get("TPU") else ""),
+        ])
+
     def create_node(self, node_type, node_config, labels):
         self._n += 1
         name = f"{self.prefix}-{node_type}-{self._n}"
@@ -153,6 +181,8 @@ class GCETpuNodeProvider(NodeProvider):
             f"--project={self.project}", f"--zone={self.zone}",
             f"--accelerator-type={acc}",
             f"--version={node_config.get('runtime_version', 'tpu-ubuntu2204-base')}",
+            "--metadata",
+            "startup-script=" + self._startup_script(node_config, labels),
         )
         return [name]
 

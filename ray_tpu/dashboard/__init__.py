@@ -1,0 +1,12 @@
+"""ray_tpu.dashboard — cluster dashboard + job submission server.
+
+Reference: python/ray/dashboard (DashboardHead head.py:49, job module).
+HTTP API over GCS state plus a subprocess-based JobManager;
+JobSubmissionClient mirrors ray.job_submission.JobSubmissionClient.
+"""
+
+from ray_tpu.dashboard.head import DashboardHead
+from ray_tpu.dashboard.job_client import JobSubmissionClient
+from ray_tpu.dashboard.job_manager import JobManager
+
+__all__ = ["DashboardHead", "JobManager", "JobSubmissionClient"]

@@ -51,14 +51,31 @@ def cmd_start(args) -> int:
         # `ray start` daemonizes) — drop the auto-stop hook
         atexit.unregister(node.stop)
         addr = f"{node.gcs_addr[0]}:{node.gcs_addr[1]}"
-        _write_state(
-            {
-                "address": addr,
-                "gcs_pid": node.gcs_proc.pid,
-                "raylet_pids": [node.raylet_proc.pid],
-                "session_dir": node.session_dir,
-            }
-        )
+        state = {
+            "address": addr,
+            "gcs_pid": node.gcs_proc.pid,
+            "raylet_pids": [node.raylet_proc.pid],
+            "session_dir": node.session_dir,
+        }
+        dash_port = getattr(args, "dashboard_port", 8265)
+        if dash_port:
+            repo_root = os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))
+            env = dict(os.environ)
+            env["PYTHONPATH"] = os.pathsep.join(
+                p for p in [repo_root, env.get("PYTHONPATH", "")] if p)
+            dash = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.dashboard.head",
+                 "--gcs-addr", addr, "--port", str(dash_port)],
+                env=env,
+                stdout=open(os.path.join(node.session_dir,
+                                         "dashboard.log"), "ab"),
+                stderr=subprocess.STDOUT,
+            )
+            state["dashboard_pid"] = dash.pid
+            state["dashboard_address"] = f"http://127.0.0.1:{dash_port}"
+            print(f"  dashboard: http://127.0.0.1:{dash_port}")
+        _write_state(state)
         print(f"ray_tpu head started.\n  address: {addr}")
         print(f"  connect with: ray_tpu.init(address='{addr}')")
         return 0
@@ -78,17 +95,20 @@ def cmd_start(args) -> int:
     env["RAY_TPU_CONFIG_JSON"] = config.to_json()
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     env["PYTHONPATH"] = os.pathsep.join(p for p in [repo_root, env.get("PYTHONPATH", "")] if p)
+    cmd = [
+        sys.executable, "-m", "ray_tpu._private.raylet.raylet",
+        "--node-id", NodeID.from_random().hex(),
+        "--gcs-addr", args.address,
+        "--resources-json", json.dumps(resources),
+        "--store-socket", store_socket,
+        "--store-capacity", str(config.object_store_memory_bytes),
+        "--session-dir", session_dir,
+        "--port-file", port_file,
+    ]
+    if getattr(args, "labels", None):
+        cmd += ["--labels-json", args.labels]
     proc = subprocess.Popen(
-        [
-            sys.executable, "-m", "ray_tpu._private.raylet.raylet",
-            "--node-id", NodeID.from_random().hex(),
-            "--gcs-addr", args.address,
-            "--resources-json", json.dumps(resources),
-            "--store-socket", store_socket,
-            "--store-capacity", str(config.object_store_memory_bytes),
-            "--session-dir", session_dir,
-            "--port-file", port_file,
-        ],
+        cmd,
         env=env,
         stdout=open(os.path.join(session_dir, "raylet.log"), "ab"),
         stderr=subprocess.STDOUT,
@@ -110,7 +130,8 @@ def cmd_stop(_args) -> int:
     state = _read_state()
     n = 0
     if state:
-        for pid in state.get("raylet_pids", []) + [state.get("gcs_pid")]:
+        for pid in state.get("raylet_pids", []) + [
+                state.get("gcs_pid"), state.get("dashboard_pid")]:
             if pid:
                 try:
                     os.kill(pid, signal.SIGTERM)
@@ -147,6 +168,37 @@ def cmd_submit(args) -> int:
     return subprocess.call([sys.executable, args.script] + args.script_args, env=env)
 
 
+def cmd_job(args) -> int:
+    """Job-submission client commands (reference: `ray job` CLI,
+    dashboard/modules/job/cli.py)."""
+    from ray_tpu.dashboard import JobSubmissionClient
+
+    address = args.address or (_read_state() or {}).get(
+        "dashboard_address") or "http://127.0.0.1:8265"
+    client = JobSubmissionClient(address)
+    if args.action == "submit":
+        if not args.arg:
+            print("usage: ray-tpu job submit '<entrypoint>'", file=sys.stderr)
+            return 1
+        sid = client.submit_job(entrypoint=args.arg)
+        print(sid)
+        return 0
+    if args.action == "list":
+        for j in client.list_jobs():
+            print(f"{j['submission_id']}  {j['status']:10s}  {j['entrypoint']}")
+        return 0
+    if not args.arg:
+        print("submission id required", file=sys.stderr)
+        return 1
+    if args.action == "status":
+        print(client.get_job_status(args.arg))
+    elif args.action == "logs":
+        print(client.get_job_logs(args.arg), end="")
+    elif args.action == "stop":
+        print(client.stop_job(args.arg))
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ray-tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -156,6 +208,10 @@ def main(argv=None) -> int:
     sp.add_argument("--address", default=None, help="GCS host:port to join")
     sp.add_argument("--num-cpus", type=float, default=None)
     sp.add_argument("--num-tpus", type=float, default=None)
+    sp.add_argument("--dashboard-port", type=int, default=8265,
+                    help="0 disables the dashboard")
+    sp.add_argument("--labels", default=None,
+                    help="JSON node labels (worker join; autoscaler key)")
     sp.set_defaults(fn=cmd_start)
 
     sp = sub.add_parser("stop", help="stop processes started by this CLI")
@@ -170,6 +226,14 @@ def main(argv=None) -> int:
     sp.add_argument("script_args", nargs="*")
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_submit)
+
+    sp = sub.add_parser("job", help="job-submission API client")
+    sp.add_argument("action",
+                    choices=["submit", "status", "logs", "stop", "list"])
+    sp.add_argument("arg", nargs="?", help="entrypoint or submission id")
+    sp.add_argument("--address", default=None,
+                    help="dashboard URL, e.g. http://127.0.0.1:8265")
+    sp.set_defaults(fn=cmd_job)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -1,0 +1,123 @@
+"""Dashboard + job submission tests (reference:
+dashboard/modules/job/tests): REST API over live cluster state, job
+lifecycle end-to-end (submit → run against the cluster → logs →
+terminal state), stop, and the HTML overview."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.dashboard import DashboardHead, JobSubmissionClient
+
+
+@pytest.fixture(scope="module")
+def dash_cluster():
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes()
+    head = DashboardHead(cluster.gcs_addr, port=0)
+    client = JobSubmissionClient(head.address)
+    yield cluster, head, client
+    head.shutdown()
+    cluster.shutdown()
+
+
+def _wait_status(client, sid, want, timeout=90):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        st = client.get_job_status(sid)
+        if st in want:
+            return st
+        time.sleep(0.3)
+    raise AssertionError(
+        f"job {sid} stuck in {client.get_job_status(sid)}; logs:\n"
+        + client.get_job_logs(sid))
+
+
+class TestHttpApi:
+    def test_version_and_nodes(self, dash_cluster):
+        _, head, _ = dash_cluster
+        with urllib.request.urlopen(head.address + "/api/version") as r:
+            assert "version" in json.loads(r.read())
+        with urllib.request.urlopen(head.address + "/api/nodes") as r:
+            nodes = json.loads(r.read())
+        assert len(nodes) == 1 and nodes[0]["Alive"]
+
+    def test_html_overview(self, dash_cluster):
+        _, head, _ = dash_cluster
+        with urllib.request.urlopen(head.address + "/") as r:
+            html = r.read().decode()
+        assert "Nodes (1)" in html and "Jobs" in html
+
+    def test_unknown_route_404(self, dash_cluster):
+        _, head, _ = dash_cluster
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(head.address + "/api/nope")
+        assert ei.value.code == 404
+
+    def test_cluster_status_endpoint(self, dash_cluster):
+        _, head, _ = dash_cluster
+        with urllib.request.urlopen(head.address + "/api/cluster_status") as r:
+            status = json.loads(r.read())
+        assert status["nodes"] and "pending_actors" in status
+
+
+class TestJobLifecycle:
+    def test_submit_run_against_cluster_logs(self, dash_cluster):
+        """The canonical flow: the submitted script connects to the
+        cluster via RAY_TPU_ADDRESS and runs remote work."""
+        _, _, client = dash_cluster
+        script = (
+            "import ray_tpu; ray_tpu.init(); "
+            "f = ray_tpu.remote(lambda x: x * 2); "
+            "print('answer', sum(ray_tpu.get([f.remote(i) for i in range(5)]))); "
+            "ray_tpu.shutdown()"
+        )
+        sid = client.submit_job(
+            entrypoint=f'python -c "{script}"',
+            metadata={"owner": "test"})
+        assert _wait_status(client, sid, {"SUCCEEDED", "FAILED"}) \
+            == "SUCCEEDED"
+        logs = client.get_job_logs(sid)
+        assert "answer 20" in logs
+        info = client.get_job_info(sid)
+        assert info["metadata"] == {"owner": "test"}
+        assert any(j["submission_id"] == sid for j in client.list_jobs())
+
+    def test_failing_job_reports_failed(self, dash_cluster):
+        _, _, client = dash_cluster
+        sid = client.submit_job(entrypoint="python -c 'raise SystemExit(3)'")
+        assert _wait_status(client, sid, {"SUCCEEDED", "FAILED"}) == "FAILED"
+        assert "exit code 3" in client.get_job_info(sid)["message"]
+
+    def test_stop_long_running_job(self, dash_cluster):
+        _, _, client = dash_cluster
+        sid = client.submit_job(entrypoint="sleep 600")
+        _wait_status(client, sid, {"RUNNING"})
+        assert client.stop_job(sid) is True
+        assert _wait_status(client, sid, {"STOPPED"}) == "STOPPED"
+
+    def test_env_vars_runtime_env(self, dash_cluster):
+        _, _, client = dash_cluster
+        sid = client.submit_job(
+            entrypoint="python -c \"import os; print('V=', os.environ['MY_FLAG'])\"",
+            runtime_env={"env_vars": {"MY_FLAG": "hello42"}})
+        _wait_status(client, sid, {"SUCCEEDED"})
+        assert "V= hello42" in client.get_job_logs(sid)
+
+    def test_duplicate_submission_id_rejected(self, dash_cluster):
+        _, _, client = dash_cluster
+        sid = client.submit_job(entrypoint="true", submission_id="dup_1")
+        _wait_status(client, sid, {"SUCCEEDED"})
+        with pytest.raises(RuntimeError, match="already exists"):
+            client.submit_job(entrypoint="true", submission_id="dup_1")
+
+    def test_tail_logs(self, dash_cluster):
+        _, _, client = dash_cluster
+        sid = client.submit_job(
+            entrypoint="python -c \"print('line1'); print('line2')\"")
+        text = "".join(client.tail_job_logs(sid))
+        assert "line1" in text and "line2" in text
