@@ -44,6 +44,14 @@ from ray_tpu._private.rpc import (
     get_client,
 )
 from ray_tpu._private.serialization import deserialize, serialize
+
+
+def _env_hash_of(env: Optional[dict]) -> str:
+    if not env:
+        return ""
+    from ray_tpu._private.runtime_env import env_hash
+
+    return env_hash(env)
 from ray_tpu._private.task_spec import (
     FunctionDescriptor,
     SchedulingStrategy,
@@ -1242,7 +1250,10 @@ class CoreWorker(CoreRuntime):
             retry_exceptions=opts.retry_exceptions,
             caller_addr=self.address,
             serialized_function=dumps_function(remote_function._function),
-            runtime_env=opts.runtime_env,
+            # prepared HERE on the user thread: packaging uploads block on
+            # GCS RPCs, which must never run on the io loop (_pack_spec
+            # executes there during the push)
+            runtime_env=self._prepared_runtime_env(opts.runtime_env),
         )
         spec.is_streaming_generator = streaming
         spec.kwargs_map = ser_kwargs  # type: ignore[attr-defined]
@@ -1296,6 +1307,7 @@ class CoreWorker(CoreRuntime):
                 bundle_index=strategy.placement_group_bundle_index,
                 lease_timeout=config.worker_lease_timeout_ms / 1000.0,
                 timeout=config.worker_lease_timeout_ms / 1000.0 + 10.0,
+                runtime_env_hash=spec.runtime_env_hash(),
             )
             granted_by: Tuple[str, int] = self.raylet_addr
             reply = await self.raylet.acall("RequestWorkerLease", **kwargs)
@@ -1433,9 +1445,26 @@ class CoreWorker(CoreRuntime):
             self._py_paths_cache = cached
         return cached
 
+    @staticmethod
+    def _env_hash(env: dict) -> str:
+        return _env_hash_of(env)
+
+    def _prepared_runtime_env(self, task_env) -> dict:
+        """Merge job-level + per-task runtime envs and package local dirs
+        into the GCS KV (reference: runtime_env plugins upload through
+        the agent; _private/runtime_env/working_dir.py)."""
+        from ray_tpu._private import runtime_env as rt
+
+        job_env = getattr(self, "job_runtime_env", None)
+        if not job_env and not task_env:
+            return {}
+        merged = rt.merge_runtime_envs(job_env, task_env)
+        return rt.prepare_runtime_env(merged, self.gcs)
+
     def _pack_spec(self, spec: TaskSpec) -> dict:
         return {
             "py_paths": self._driver_py_paths(),
+            "runtime_env": spec.runtime_env,  # prepared at submit time
             "streaming": spec.is_streaming_generator,
             "task_id": spec.task_id.binary(),
             "job_id": spec.job_id.binary(),
@@ -1716,6 +1745,7 @@ class CoreWorker(CoreRuntime):
 
         spec_payload = {
             "py_paths": self._driver_py_paths(),
+            "runtime_env": self._prepared_runtime_env(opts.runtime_env),
             "serialized_class": dumps_function(actor_class._cls),
             "class_name": actor_class._name,
             "args": [
@@ -1757,6 +1787,7 @@ class CoreWorker(CoreRuntime):
             pg_id=strategy.placement_group_id,
             bundle_index=strategy.placement_group_bundle_index,
             cpu_scheduling_only=opts.cpu_scheduling_only,
+            runtime_env_hash=_env_hash_of(spec_payload["runtime_env"]),
         )
         if "error" in reply:
             raise ValueError(reply["error"])

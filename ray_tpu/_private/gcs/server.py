@@ -74,6 +74,9 @@ class ActorInfo:
     # a lease request for this actor is queued at some raylet — its shape
     # already shows in that node's pending_shapes (autoscaler dedupe)
     lease_in_flight: bool = False
+    # workers tainted by a runtime env are dedicated to it
+    runtime_env_hash: str = ""
+
 
 
 @dataclass
@@ -390,6 +393,7 @@ class GcsServer:
         pg_id: Optional[str] = None,
         bundle_index: int = -1,
         cpu_scheduling_only: bool = False,
+        runtime_env_hash: str = "",
     ) -> dict:
         if name:
             existing = self.named_actors.get((namespace, name))
@@ -413,6 +417,7 @@ class GcsServer:
             pg_id=pg_id,
             bundle_index=bundle_index,
             cpu_scheduling_only=cpu_scheduling_only,
+            runtime_env_hash=runtime_env_hash,
         )
         self.actors[actor_id] = actor
         if name:
@@ -477,6 +482,7 @@ class GcsServer:
                         bundle_index=actor.bundle_index,
                         lease_timeout=50.0,
                         release_cpu_after_grant=actor.cpu_scheduling_only,
+                        runtime_env_hash=actor.runtime_env_hash,
                         timeout=60,
                     )
                 finally:

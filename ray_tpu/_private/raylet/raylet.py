@@ -85,6 +85,10 @@ class WorkerHandle:
     busy_lease: Optional[str] = None
     idle_since: float = field(default_factory=time.monotonic)
     dead: bool = False
+    # runtime env this worker is tainted with ("" = clean). A worker
+    # that applied env A is never leased for env B (reference: the
+    # worker pool dedicates workers per runtime env, worker_pool.h:280)
+    env_hash: str = ""
 
 
 @dataclass
@@ -210,11 +214,31 @@ class Raylet:
         logger.info("worker %s registered at %s", worker_id[:8], addr)
         return {"ok": True, "node_id": self.node_id}
 
-    async def _get_idle_worker(self) -> Optional[WorkerHandle]:
-        while self.idle_workers:
-            w = self.idle_workers.pop()
-            if not w.dead and w.proc.poll() is None:
-                return w
+    async def _get_idle_worker(self, env_hash: str = "") -> Optional[WorkerHandle]:
+        # prefer a worker already tainted with THIS env, then a clean
+        # one (which the env will taint); never cross-match envs
+        match = None
+        for w in reversed(self.idle_workers):
+            if w.dead or w.proc.poll() is not None:
+                continue
+            if w.env_hash == env_hash:
+                match = w
+                break
+            if match is None and not w.env_hash:
+                match = w
+        if match is not None:
+            self.idle_workers.remove(match)
+            match.env_hash = env_hash or match.env_hash
+            # drop any dead entries we skipped over
+            self.idle_workers = [
+                w for w in self.idle_workers
+                if not w.dead and w.proc.poll() is None
+            ]
+            return match
+        self.idle_workers = [
+            w for w in self.idle_workers
+            if not w.dead and w.proc.poll() is None
+        ]
         if len(self.workers) + self._starting_workers >= config.max_workers_per_node:
             return None
         self._starting_workers += 1
@@ -235,6 +259,7 @@ class Raylet:
                 handle.proc.kill()
                 self.workers.pop(handle.worker_id, None)
                 return None
+            handle.env_hash = env_hash
             return handle
         finally:
             self._starting_workers -= 1
@@ -254,6 +279,7 @@ class Raylet:
         lease_timeout: float = 25.0,
         release_cpu_after_grant: bool = False,
         allow_spillback: bool = True,
+        runtime_env_hash: str = "",
     ) -> dict:
         req = {
             "resources": dict(resources),
@@ -263,6 +289,7 @@ class Raylet:
             "pg_id": pg_id,
             "bundle_index": bundle_index,
             "release_cpu_after_grant": release_cpu_after_grant,
+            "runtime_env_hash": runtime_env_hash,
         }
         logger.debug(
             "lease request %s avail=%s idle=%d workers=%d",
@@ -391,7 +418,7 @@ class Raylet:
         alloc = rs.allocate(req["resources"])
         if alloc is None:
             return None
-        worker = await self._get_idle_worker()
+        worker = await self._get_idle_worker(req.get("runtime_env_hash") or "")
         if worker is None:
             rs.release(alloc)
             return None

@@ -1,0 +1,196 @@
+"""Runtime environments — per-job/task/actor execution environments.
+
+Reference: python/ray/_private/runtime_env/ (plugins: working_dir.py,
+py_modules.py, pip.py, ...) and the per-node runtime-env agent. Three
+fields are supported natively:
+
+- ``env_vars``: {name: value} exported in the worker before user code,
+- ``working_dir``: a local directory, zipped by the driver into the GCS
+  KV (content-addressed) and extracted + chdir'd + sys.path'd on the
+  worker,
+- ``py_modules``: list of local directories, shipped the same way and
+  added to sys.path.
+
+``pip``/``conda``/``uv`` are rejected with a clear error (no package
+installation in this image; reference gates these behind the runtime-env
+agent).
+
+Worker semantics: applying an env marks the worker (env vars stay set,
+paths stay on sys.path) — the reference dedicates workers to a runtime
+env rather than sandboxing per task, and so do we; application is
+idempotent per content hash.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import sys
+import zipfile
+from typing import Any, Dict, List, Optional, Tuple
+
+PKG_NAMESPACE = "runtime_env_packages"
+_UNSUPPORTED = ("pip", "conda", "uv", "container", "image_uri")
+
+# driver-side upload cache: abspath -> (signature, pkg_key)
+_upload_cache: Dict[str, Tuple[Tuple, str]] = {}
+# worker-side: applied env hashes + extracted package keys
+_applied_envs: set = set()
+_extracted: Dict[str, str] = {}
+
+
+def _zip_dir(path: str) -> bytes:
+    buf = io.BytesIO()
+    base = os.path.abspath(path)
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for root, dirs, files in os.walk(base):
+            dirs[:] = [d for d in dirs if d != "__pycache__"]
+            for f in files:
+                full = os.path.join(root, f)
+                zf.write(full, os.path.relpath(full, base))
+    return buf.getvalue()
+
+
+# signature memo: path -> (checked_at, signature). Submitting many
+# tasks with the same working_dir must not re-walk the tree every time.
+_SIG_TTL_S = 5.0
+_sig_cache: Dict[str, Tuple[float, Tuple]] = {}
+
+
+def _dir_signature(path: str) -> Tuple:
+    """Cheap change detector: (max mtime incl. directories, file count).
+    Directory mtimes change on deletion, and the count catches removals
+    whose parent-dir mtime granularity misses them."""
+    import time as _t
+
+    cached = _sig_cache.get(path)
+    now = _t.monotonic()
+    if cached and now - cached[0] < _SIG_TTL_S:
+        return cached[1]
+    mx = os.path.getmtime(path)
+    count = 0
+    for root, dirs, files in os.walk(path):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        try:
+            mx = max(mx, os.path.getmtime(root))
+        except OSError:
+            pass
+        for f in files:
+            count += 1
+            try:
+                mx = max(mx, os.path.getmtime(os.path.join(root, f)))
+            except OSError:
+                pass
+    sig = (mx, count)
+    _sig_cache[path] = (now, sig)
+    return sig
+
+
+def upload_package(gcs, path: str) -> str:
+    """Zip ``path`` into the GCS KV; returns the content-addressed key
+    (reference: runtime_env packaging.py upload_package_to_gcs)."""
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        raise ValueError(f"runtime_env directory not found: {path!r}")
+    sig = _dir_signature(path)
+    cached = _upload_cache.get(path)
+    if cached and cached[0] == sig:
+        return cached[1]
+    blob = _zip_dir(path)
+    key = "pkg_" + hashlib.sha256(blob).hexdigest()[:20]
+    if not gcs.call("KVGet", ns=PKG_NAMESPACE, key=key, timeout=30):
+        gcs.call("KVPut", ns=PKG_NAMESPACE, key=key, value=blob,
+                 overwrite=True, timeout=60)
+    _upload_cache[path] = (sig, key)
+    return key
+
+
+def prepare_runtime_env(env: Optional[Dict[str, Any]], gcs) -> Dict[str, Any]:
+    """Driver side: validate + replace local dirs with package keys."""
+    if not env:
+        return {}
+    for k in _UNSUPPORTED:
+        if env.get(k):
+            raise ValueError(
+                f"runtime_env field {k!r} is not supported in this build "
+                f"(supported: env_vars, working_dir, py_modules)")
+    out: Dict[str, Any] = {}
+    if env.get("env_vars"):
+        out["env_vars"] = {str(k): str(v)
+                           for k, v in env["env_vars"].items()}
+    wd = env.get("working_dir")
+    if wd:
+        out["working_dir_pkg"] = wd if str(wd).startswith("pkg_") \
+            else upload_package(gcs, wd)
+    for m in env.get("py_modules") or []:
+        out.setdefault("py_module_pkgs", []).append(
+            m if str(m).startswith("pkg_") else upload_package(gcs, m))
+    return out
+
+
+def _extract_package(gcs, key: str, cache_dir: str) -> str:
+    dest = _extracted.get(key)
+    if dest:
+        return dest
+    dest = os.path.join(cache_dir, key)
+    if not os.path.isdir(dest):
+        blob = gcs.call("KVGet", ns=PKG_NAMESPACE, key=key, timeout=60)
+        if blob is None:
+            raise RuntimeError(f"runtime_env package {key} missing from GCS")
+        tmp = dest + ".tmp"
+        with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+            zf.extractall(tmp)
+        try:
+            os.rename(tmp, dest)
+        except OSError:
+            pass  # concurrent extraction won
+    _extracted[key] = dest
+    return dest
+
+
+def env_hash(env: Dict[str, Any]) -> str:
+    import json
+
+    return hashlib.sha256(
+        json.dumps(env, sort_keys=True, default=str).encode()).hexdigest()
+
+
+def apply_runtime_env(env: Optional[Dict[str, Any]], gcs,
+                      cache_dir: str) -> None:
+    """Worker side: idempotently apply a PREPARED runtime env."""
+    if not env:
+        return
+    h = env_hash(env)
+    if h in _applied_envs:
+        return
+    for k, v in (env.get("env_vars") or {}).items():
+        os.environ[k] = v
+    for key in env.get("py_module_pkgs") or []:
+        p = _extract_package(gcs, key, cache_dir)
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    wd_key = env.get("working_dir_pkg")
+    if wd_key:
+        p = _extract_package(gcs, wd_key, cache_dir)
+        if p not in sys.path:
+            sys.path.insert(0, p)
+        os.chdir(p)
+    _applied_envs.add(h)
+
+
+def merge_runtime_envs(job_env: Optional[Dict[str, Any]],
+                       task_env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Task env overrides job env; env_vars merge key-wise (reference:
+    runtime_env merge semantics, _private/runtime_env/merge.py)."""
+    job_env = job_env or {}
+    task_env = task_env or {}
+    out = dict(job_env)
+    for k, v in task_env.items():
+        if k == "env_vars":
+            merged = dict(job_env.get("env_vars") or {})
+            merged.update(v or {})
+            out["env_vars"] = merged
+        else:
+            out[k] = v
+    return out

@@ -106,12 +106,22 @@ class TaskSpec:
 
     @property
     def scheduling_class(self) -> Tuple:
-        """Group tasks by (fn, resources) for lease reuse (reference:
-        SchedulingClass in src/ray/common/task/task_spec.h)."""
+        """Group tasks by (fn, resources, runtime env) for lease reuse
+        (reference: SchedulingClass in src/ray/common/task/task_spec.h —
+        the reference's class includes the runtime env so leased workers
+        are never shared across envs)."""
         return (
             self.function_descriptor.key(),
             tuple(sorted(self.resources.items())),
             self.scheduling_strategy.kind,
             self.scheduling_strategy.placement_group_id,
             self.scheduling_strategy.placement_group_bundle_index,
+            self.runtime_env_hash(),
         )
+
+    def runtime_env_hash(self) -> str:
+        if not self.runtime_env:
+            return ""
+        from ray_tpu._private.runtime_env import env_hash
+
+        return env_hash(self.runtime_env)

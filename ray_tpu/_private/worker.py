@@ -125,6 +125,15 @@ def init(
                 dashboard=dashboard,
             )
             w.job_id = w.core.job_id
+            # job-level runtime env: merged under every task/actor env
+            w.core.job_runtime_env = runtime_env or {}
+        if local_mode and runtime_env:
+            # in-process execution: env_vars apply directly; packaged
+            # fields are meaningless without worker processes
+            import os as _os
+
+            for k, v in (runtime_env.get("env_vars") or {}).items():
+                _os.environ[str(k)] = str(v)
         w.reference_counter.set_on_zero_callback(w.core.free_object)
         if hasattr(w.core, "_on_borrow_released"):
             w.reference_counter.set_borrow_release_callback(w.core._on_borrow_released)

@@ -413,9 +413,28 @@ class WorkerServer:
             if p not in sys.path:
                 sys.path.append(p)
 
+    def _apply_runtime_env(self, env) -> None:
+        """Apply a prepared runtime env (env_vars / working_dir /
+        py_modules packages) — idempotent per env hash; marks this
+        worker like the reference's env-dedicated workers."""
+        if not env:
+            return
+        import tempfile
+
+        from ray_tpu._private import runtime_env as rt
+
+        cache = os.path.join(tempfile.gettempdir(), "ray_tpu_rtenv")
+        os.makedirs(cache, exist_ok=True)
+        try:
+            rt.apply_runtime_env(env, self.core.gcs, cache)
+        except Exception:  # noqa: BLE001
+            logger.exception("runtime_env application failed")
+            raise
+
     # -- normal tasks ---------------------------------------------------
     def PushTask(self, spec_payload: dict) -> dict:
         self._apply_py_paths(spec_payload.get("py_paths"))
+        self._apply_runtime_env(spec_payload.get("runtime_env"))
         fn_bytes = spec_payload["serialized_function"]
         fn = self._function_cache.get(fn_bytes)
         if fn is None:
@@ -510,6 +529,7 @@ class WorkerServer:
         spec = pickle.loads(serialized_spec)
         self._apply_py_paths(spec.get("py_paths"))
         try:
+            self._apply_runtime_env(spec.get("runtime_env"))
             cls = loads_function(spec["serialized_class"])
             args, kwargs = _resolve_args(spec["args"], spec["kwargs"])
             instance = cls(*args, **kwargs)

@@ -228,6 +228,8 @@ class Autoscaler:
         live = self.provider.non_terminated_nodes()
         self._launched = {nid: meta for nid, meta in self._launched.items()
                           if nid in live}
+        self._launch_times = {nid: t for nid, t in self._launch_times.items()
+                              if nid in self._launched}
         type_counts: Dict[str, int] = {}
         slice_units: Dict[str, set] = {}
         for nid, (tname, sid) in self._launched.items():

@@ -176,14 +176,25 @@ class GCETpuNodeProvider(NodeProvider):
         self._n += 1
         name = f"{self.prefix}-{node_type}-{self._n}"
         acc = node_config["accelerator_type"]
-        self._gcloud(
-            "compute", "tpus", "tpu-vm", "create", name,
-            f"--project={self.project}", f"--zone={self.zone}",
-            f"--accelerator-type={acc}",
-            f"--version={node_config.get('runtime_version', 'tpu-ubuntu2204-base')}",
-            "--metadata",
-            "startup-script=" + self._startup_script(node_config, labels),
-        )
+        # --metadata splits on commas (the JSON labels always contain
+        # one) — the script must go through --metadata-from-file
+        with tempfile.NamedTemporaryFile(
+                "w", suffix=".sh", delete=False) as f:
+            f.write(self._startup_script(node_config, labels))
+            script_path = f.name
+        try:
+            self._gcloud(
+                "compute", "tpus", "tpu-vm", "create", name,
+                f"--project={self.project}", f"--zone={self.zone}",
+                f"--accelerator-type={acc}",
+                f"--version={node_config.get('runtime_version', 'tpu-ubuntu2204-base')}",
+                f"--metadata-from-file=startup-script={script_path}",
+            )
+        finally:
+            try:
+                os.unlink(script_path)
+            except OSError:
+                pass
         return [name]
 
     def terminate_node(self, provider_node_id):

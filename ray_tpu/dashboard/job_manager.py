@@ -33,6 +33,7 @@ class JobManager:
         self.gcs = RpcClient(*self.gcs_addr)
         self.log_dir = log_dir or tempfile.mkdtemp(prefix="ray_tpu_jobs_")
         self._procs: Dict[str, subprocess.Popen] = {}
+        self._stopping: set = set()  # sids being stopped (monitor race)
         self._lock = threading.Lock()
 
     # -- KV-backed records --------------------------------------------
@@ -109,6 +110,12 @@ class JobManager:
 
     def _monitor(self, submission_id: str, proc: subprocess.Popen) -> None:
         rc = proc.wait()
+        with self._lock:
+            # both waits return at process death; the _stopping marker is
+            # set BEFORE the signal, so a user-stopped job is never
+            # overwritten as FAILED(exit -15) by this thread
+            if submission_id in self._stopping:
+                return
         rec = self._get_record(submission_id) or {}
         if rec.get("status") == "STOPPED":
             return  # stop_job already wrote the terminal record
@@ -141,6 +148,7 @@ class JobManager:
     def stop_job(self, submission_id: str) -> bool:
         with self._lock:
             proc = self._procs.pop(submission_id, None)
+            self._stopping.add(submission_id)
         rec = self._get_record(submission_id)
         pid = proc.pid if proc is not None else (rec or {}).get("pid")
         signaled = False

@@ -1,0 +1,121 @@
+"""Runtime env tests (reference: python/ray/tests/test_runtime_env*):
+env_vars, working_dir, py_modules applied on workers; job-level merge;
+unsupported fields rejected."""
+
+import os
+import sys
+
+import pytest
+
+import ray_tpu
+
+
+class TestMergeAndValidate:
+    def test_merge_task_overrides_job(self):
+        from ray_tpu._private.runtime_env import merge_runtime_envs
+
+        job = {"env_vars": {"A": "1", "B": "2"}, "working_dir": "/j"}
+        task = {"env_vars": {"B": "3"}}
+        m = merge_runtime_envs(job, task)
+        assert m["env_vars"] == {"A": "1", "B": "3"}
+        assert m["working_dir"] == "/j"
+
+    def test_unsupported_field_rejected(self, ray_start_regular):
+        @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+        def f():
+            return 1
+
+        with pytest.raises(Exception, match="not supported"):
+            ray_tpu.get(f.remote())
+
+
+class TestClusterRuntimeEnv:
+    def test_env_vars_per_task(self, ray_start_regular):
+        @ray_tpu.remote(runtime_env={"env_vars": {"MY_RT_FLAG": "v42"}})
+        def f():
+            import os
+
+            return os.environ.get("MY_RT_FLAG")
+
+        assert ray_tpu.get(f.remote()) == "v42"
+
+    def test_env_vars_on_actor(self, ray_start_regular):
+        @ray_tpu.remote(runtime_env={"env_vars": {"ACTOR_FLAG": "yes"}})
+        class A:
+            def read(self):
+                import os
+
+                return os.environ.get("ACTOR_FLAG")
+
+        a = A.remote()
+        assert ray_tpu.get(a.read.remote()) == "yes"
+        ray_tpu.kill(a)
+
+    def test_working_dir_ships_files(self, ray_start_regular, tmp_path):
+        d = tmp_path / "wd"
+        d.mkdir()
+        (d / "data.txt").write_text("hello-from-working-dir")
+        (d / "helper_mod_rt.py").write_text("VALUE = 123\n")
+
+        @ray_tpu.remote(runtime_env={"working_dir": str(d)})
+        def f():
+            import os
+
+            import helper_mod_rt  # shipped alongside data.txt
+
+            with open("data.txt") as fh:
+                return fh.read(), helper_mod_rt.VALUE, os.getcwd()
+
+        text, val, cwd = ray_tpu.get(f.remote())
+        assert text == "hello-from-working-dir"
+        assert val == 123
+        assert "pkg_" in cwd  # extracted package dir
+
+    def test_py_modules_importable(self, ray_start_regular, tmp_path):
+        m = tmp_path / "mods"
+        m.mkdir()
+        (m / "rt_env_pymod.py").write_text("def answer():\n    return 99\n")
+
+        @ray_tpu.remote(runtime_env={"py_modules": [str(m)]})
+        def f():
+            import rt_env_pymod
+
+            return rt_env_pymod.answer()
+
+        assert ray_tpu.get(f.remote()) == 99
+
+    def test_package_reupload_skipped(self, ray_start_regular, tmp_path):
+        from ray_tpu._private.runtime_env import upload_package
+        from ray_tpu._private import worker as worker_mod
+
+        d = tmp_path / "pkg"
+        d.mkdir()
+        (d / "x.txt").write_text("x")
+        gcs = worker_mod.global_worker.core.gcs
+        k1 = upload_package(gcs, str(d))
+        k2 = upload_package(gcs, str(d))
+        assert k1 == k2
+
+
+class TestJobLevelEnv:
+    def test_init_runtime_env_applies_to_all_tasks(self):
+        ray_tpu.init(num_cpus=2,
+                     runtime_env={"env_vars": {"JOB_WIDE": "jw1"}},
+                     ignore_reinit_error=True)
+        try:
+            @ray_tpu.remote
+            def f():
+                import os
+
+                return os.environ.get("JOB_WIDE")
+
+            @ray_tpu.remote(runtime_env={"env_vars": {"JOB_WIDE": "override"}})
+            def g():
+                import os
+
+                return os.environ.get("JOB_WIDE")
+
+            assert ray_tpu.get(f.remote()) == "jw1"
+            assert ray_tpu.get(g.remote()) == "override"
+        finally:
+            ray_tpu.shutdown()
