@@ -44,14 +44,6 @@ from ray_tpu._private.rpc import (
     get_client,
 )
 from ray_tpu._private.serialization import deserialize, serialize
-
-
-def _env_hash_of(env: Optional[dict]) -> str:
-    if not env:
-        return ""
-    from ray_tpu._private.runtime_env import env_hash
-
-    return env_hash(env)
 from ray_tpu._private.task_spec import (
     FunctionDescriptor,
     SchedulingStrategy,
@@ -1445,10 +1437,6 @@ class CoreWorker(CoreRuntime):
             self._py_paths_cache = cached
         return cached
 
-    @staticmethod
-    def _env_hash(env: dict) -> str:
-        return _env_hash_of(env)
-
     def _prepared_runtime_env(self, task_env) -> dict:
         """Merge job-level + per-task runtime envs and package local dirs
         into the GCS KV (reference: runtime_env plugins upload through
@@ -1771,6 +1759,10 @@ class CoreWorker(CoreRuntime):
         }
         import pickle
 
+        from ray_tpu._private.runtime_env import env_hash
+
+        actor_env_hash = env_hash(spec_payload["runtime_env"]) \
+            if spec_payload["runtime_env"] else ""
         strategy = opts.scheduling_strategy
         reply = self.gcs.call_retrying(
             "RegisterActor",
@@ -1787,7 +1779,7 @@ class CoreWorker(CoreRuntime):
             pg_id=strategy.placement_group_id,
             bundle_index=strategy.placement_group_bundle_index,
             cpu_scheduling_only=opts.cpu_scheduling_only,
-            runtime_env_hash=_env_hash_of(spec_payload["runtime_env"]),
+            runtime_env_hash=actor_env_hash,
         )
         if "error" in reply:
             raise ValueError(reply["error"])

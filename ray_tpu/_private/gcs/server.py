@@ -123,27 +123,154 @@ class GcsServer:
         # round; if it dies, the flag expires and raylets fall back to
         # fail-fast infeasible errors instead of queueing forever
         self.autoscaler_enabled_until = 0.0
+        self._dirty = False
+        self._needs_replay_reschedule = False
         self._load_persisted()
         self.server.register_instance(self)
 
     # ------------------------------------------------------------------
-    # persistence (KV only, file-backed — GCS restart then replays it;
-    # reference: gcs_table_storage.h over Redis/memory)
+    # persistence (file-backed snapshot of the durable tables: KV,
+    # actors, placement groups, jobs — a restarted GCS replays them and
+    # resumes; reference: gcs_init_data.h replaying from Redis,
+    # gcs_table_storage.h:200). Nodes are NOT persisted: raylets get
+    # "reregister" on their next heartbeat and repopulate the table.
     # ------------------------------------------------------------------
     def _load_persisted(self) -> None:
-        if self.storage_path and os.path.exists(self.storage_path):
-            try:
-                with open(self.storage_path, "rb") as f:
-                    self.kv = pickle.load(f)
-            except Exception:
-                logger.exception("failed to load persisted KV")
+        if not (self.storage_path and os.path.exists(self.storage_path)):
+            return
+        try:
+            with open(self.storage_path, "rb") as f:
+                snap = pickle.load(f)
+        except Exception:
+            logger.exception("failed to load persisted state")
+            return
+        if isinstance(snap, dict) and "kv" in snap:
+            self.kv = snap["kv"]
+            self._load_blobs()
+            self.actors = snap.get("actors", {})
+            self.named_actors = snap.get("named_actors", {})
+            self.placement_groups = snap.get("placement_groups", {})
+            self.jobs = snap.get("jobs", {})
+            self._job_counter = snap.get("job_counter", 0)
+            # in-flight markers are meaningless across a restart
+            for a in self.actors.values():
+                a.lease_in_flight = False
+            n_live = sum(1 for a in self.actors.values()
+                         if a.state != "DEAD")
+            logger.info(
+                "replayed persisted state: %d actors (%d live), %d PGs, "
+                "%d jobs", len(self.actors), n_live,
+                len(self.placement_groups), len(self.jobs))
+            # no loop is running during __init__ — run() kicks this off
+            self._needs_replay_reschedule = True
+        else:  # pre-snapshot format: bare KV dict
+            self.kv = snap
 
-    def _persist(self) -> None:
-        if self.storage_path:
+    async def _reschedule_replayed(self) -> None:
+        """PENDING/RESTARTING actors from before the restart need a new
+        scheduling attempt — wait for raylets to re-register first."""
+        deadline = time.monotonic() + 60
+        while not self.nodes and time.monotonic() < deadline:
+            await asyncio.sleep(0.2)
+        for actor in self.actors.values():
+            if actor.state in ("PENDING", "RESTARTING"):
+                logger.info("rescheduling replayed actor %s",
+                            actor.actor_id[:12])
+                asyncio.ensure_future(self._schedule_actor(actor))
+        for pg in self.placement_groups.values():
+            if pg.state == "PENDING":
+                logger.info("rescheduling replayed placement group %s",
+                            pg.pg_id[:12])
+                asyncio.ensure_future(self._schedule_pg(pg))
+        # ALIVE actors whose node died WHILE the GCS was down: the dead
+        # node never re-registers, so the health checker (which only
+        # scans registered nodes) would never fail them over — give
+        # every live node a grace window to come back, then treat the
+        # missing ones as dead.
+        grace = max(5.0, 3 * config.raylet_heartbeat_period_ms / 1000.0)
+        await asyncio.sleep(grace)
+        for actor in list(self.actors.values()):
+            if actor.state == "ALIVE" and actor.node_id and (
+                    actor.node_id not in self.nodes
+                    or not self.nodes[actor.node_id].alive):
+                logger.warning(
+                    "replayed actor %s was on node %s which did not "
+                    "re-register; failing over", actor.actor_id[:12],
+                    actor.node_id[:12])
+                await self._handle_actor_failure(
+                    actor, "node lost during GCS downtime")
+
+    # KV namespaces holding large immutable blobs (runtime-env packages)
+    # are persisted as write-once files beside the snapshot, keeping the
+    # snapshot itself small enough to write synchronously at critical
+    # mutations (a 100MB working_dir must not re-serialize per flush).
+    _BLOB_NAMESPACES = ("runtime_env_packages",)
+
+    def _persist(self, immediate: bool = False) -> None:
+        """Mark dirty; critical mutations (actor/PG/job registration, KV
+        writes) flush before acknowledging so a crash right after the
+        reply cannot lose acknowledged state. High-frequency updates
+        (actor state churn) coalesce into the 0.5s flush loop."""
+        self._dirty = True
+        if immediate:
+            self._flush()
+
+    def _blob_dir(self) -> str:
+        return self.storage_path + ".blobs"
+
+    def _flush(self) -> None:
+        if not (self.storage_path and self._dirty):
+            return
+        self._dirty = False
+        kv_snap: Dict[str, Any] = {}
+        try:
+            for ns, table in self.kv.items():
+                if ns in self._BLOB_NAMESPACES:
+                    bd = self._blob_dir()
+                    os.makedirs(bd, exist_ok=True)
+                    for key, blob in table.items():
+                        p = os.path.join(bd, ns + "." + key)
+                        if not os.path.exists(p):  # content-addressed
+                            with open(p + ".tmp", "wb") as f:
+                                f.write(blob)
+                            os.replace(p + ".tmp", p)
+                    kv_snap[ns] = {"__blob_keys__": list(table.keys())}
+                else:
+                    kv_snap[ns] = table
+            snap = {
+                "kv": kv_snap,
+                "actors": self.actors,
+                "named_actors": self.named_actors,
+                "placement_groups": self.placement_groups,
+                "jobs": self.jobs,
+                "job_counter": self._job_counter,
+            }
             tmp = self.storage_path + ".tmp"
             with open(tmp, "wb") as f:
-                pickle.dump(self.kv, f)
+                pickle.dump(snap, f)
             os.replace(tmp, self.storage_path)
+        except Exception:
+            logger.exception("state snapshot failed")
+            self._dirty = True
+
+    def _load_blobs(self) -> None:
+        for ns, table in list(self.kv.items()):
+            if isinstance(table, dict) and "__blob_keys__" in table:
+                loaded = {}
+                bd = self._blob_dir()
+                for key in table["__blob_keys__"]:
+                    try:
+                        with open(os.path.join(bd, ns + "." + key),
+                                  "rb") as f:
+                            loaded[key] = f.read()
+                    except OSError:
+                        logger.warning("blob %s/%s missing", ns, key)
+                self.kv[ns] = loaded
+
+    async def _flush_loop(self) -> None:
+        while True:
+            await asyncio.sleep(0.5)
+            self._flush()
 
     def _raylet(self, node_id: str) -> RpcClient:
         c = self._raylet_clients.get(node_id)
@@ -335,12 +462,14 @@ class GcsServer:
             "state": "RUNNING",
             "metadata": metadata or {},
         }
+        self._persist(immediate=True)
         return {"job_id_int": job_id_int, "job_id": job_id}
 
     async def MarkJobFinished(self, job_id: str) -> dict:
         if job_id in self.jobs:
             self.jobs[job_id]["state"] = "FINISHED"
             self.jobs[job_id]["end_time"] = time.time()
+            self._persist()
         # non-detached actors owned by the job die with it
         for actor in list(self.actors.values()):
             if actor.job_id == job_id and not actor.detached and actor.state != "DEAD":
@@ -358,7 +487,7 @@ class GcsServer:
         if not overwrite and key in table:
             return {"added": False}
         table[key] = value
-        self._persist()
+        self._persist(immediate=True)
         return {"added": True}
 
     async def KVGet(self, ns: str, key: str) -> Optional[bytes]:
@@ -366,7 +495,7 @@ class GcsServer:
 
     async def KVDel(self, ns: str, key: str) -> dict:
         self.kv.get(ns, {}).pop(key, None)
-        self._persist()
+        self._persist(immediate=True)
         return {"ok": True}
 
     async def KVKeys(self, ns: str, prefix: str = "") -> List[str]:
@@ -422,6 +551,7 @@ class GcsServer:
         self.actors[actor_id] = actor
         if name:
             self.named_actors[(namespace, name)] = actor_id
+        self._persist(immediate=True)
         asyncio.ensure_future(self._schedule_actor(actor))
         return {"actor_id": actor_id, "existing": False}
 
@@ -557,6 +687,7 @@ class GcsServer:
             "actor_state", actor_id,
             {"state": a.state, "version": a.version} if a else None,
         )
+        self._persist()  # every actor state change is a durable mutation
 
     async def GetActorInfo(self, actor_id: str) -> Optional[dict]:
         a = self.actors.get(actor_id)
@@ -691,6 +822,7 @@ class GcsServer:
             creator_job=creator_job,
         )
         self.placement_groups[pg_id] = pg
+        self._persist(immediate=True)
         asyncio.ensure_future(self._schedule_pg(pg))
         return {"pg_id": pg_id}
 
@@ -787,6 +919,7 @@ class GcsServer:
                 await self._raylet(nid).acall("CommitBundle", pg_id=pg.pg_id, bundle_index=idx)
             pg.bundle_nodes = plan
             pg.state = "CREATED"
+            self._persist()
             logger.info("placement group %s created: %s", pg.pg_id[:12], {i: n[:8] for i, n in plan.items()})
             return
         if pg.state == "PENDING":
@@ -815,6 +948,7 @@ class GcsServer:
             except Exception:
                 pass
         pg.state = "REMOVED"
+        self._persist()
         pg.bundle_nodes = {}
         return {"ok": True}
 
@@ -1059,6 +1193,9 @@ class GcsServer:
 
     async def run(self) -> None:
         asyncio.ensure_future(self._health_check_loop())
+        asyncio.ensure_future(self._flush_loop())
+        if self._needs_replay_reschedule:
+            asyncio.ensure_future(self._reschedule_replayed())
         await self._serve_metrics_http()
         await self.server.serve_forever()
 
@@ -1071,6 +1208,9 @@ def main() -> None:
     args = parser.parse_args()
     logging.basicConfig(level=args.log_level, format="[gcs] %(levelname)s %(message)s")
     server = GcsServer(args.port, args.storage_path)
+    import atexit
+
+    atexit.register(server._flush)
     try:
         asyncio.run(server.run())
     except KeyboardInterrupt:

@@ -240,7 +240,21 @@ class Raylet:
             if not w.dead and w.proc.poll() is None
         ]
         if len(self.workers) + self._starting_workers >= config.max_workers_per_node:
-            return None
+            if not self.idle_workers:
+                return None
+            # at the cap with only env-mismatched idle workers: evict one
+            # to make room (reference: the worker pool kills idle workers
+            # of other envs rather than starving the request)
+            victim = self.idle_workers.pop(0)
+            victim.dead = True
+            self.workers.pop(victim.worker_id, None)
+            try:
+                victim.proc.terminate()
+            except Exception:  # noqa: BLE001
+                pass
+            logger.info(
+                "evicted idle worker %s (env %s) to serve a different env",
+                victim.worker_id[:8], victim.env_hash[:8] or "<clean>")
         self._starting_workers += 1
         try:
             handle = self._spawn_worker()

@@ -54,7 +54,9 @@ def _zip_dir(path: str) -> bytes:
 
 # signature memo: path -> (checked_at, signature). Submitting many
 # tasks with the same working_dir must not re-walk the tree every time.
-_SIG_TTL_S = 5.0
+# The TTL bounds staleness for an edit-and-resubmit loop to ~1s (the
+# reference uploads working_dir once per JOB, i.e. unbounded staleness).
+_SIG_TTL_S = 1.0
 _sig_cache: Dict[str, Tuple[float, Tuple]] = {}
 
 
@@ -138,13 +140,19 @@ def _extract_package(gcs, key: str, cache_dir: str) -> str:
         blob = gcs.call("KVGet", ns=PKG_NAMESPACE, key=key, timeout=60)
         if blob is None:
             raise RuntimeError(f"runtime_env package {key} missing from GCS")
-        tmp = dest + ".tmp"
+        # unique tmp dir per extractor: the cache dir is shared by every
+        # worker process on the node, and a shared ".tmp" path would let
+        # one extractor rename another's half-written tree into place
+        import shutil
+        import tempfile as _tf
+
+        tmp = _tf.mkdtemp(prefix=key + ".", dir=cache_dir)
         with zipfile.ZipFile(io.BytesIO(blob)) as zf:
             zf.extractall(tmp)
         try:
             os.rename(tmp, dest)
         except OSError:
-            pass  # concurrent extraction won
+            shutil.rmtree(tmp, ignore_errors=True)  # concurrent winner
     _extracted[key] = dest
     return dest
 

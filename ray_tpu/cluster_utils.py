@@ -50,11 +50,17 @@ class Cluster:
     """One GCS + N raylets on this machine, each raylet a real daemon
     process owning its own object store and workers."""
 
-    def __init__(self):
+    def __init__(self, gcs_storage: bool = False):
+        """gcs_storage=True enables file-backed GCS persistence so
+        ``restart_gcs()`` replays state (reference: GCS fault tolerance
+        over Redis, gcs_init_data.h)."""
         self.session_dir = tempfile.mkdtemp(prefix="ray_tpu_cluster_")
         self.gcs_proc: Optional[subprocess.Popen] = None
         self.gcs_port: Optional[int] = None
         self.nodes: List[ClusterNode] = []
+        self.gcs_storage_path = (
+            os.path.join(self.session_dir, "gcs_state.bin")
+            if gcs_storage else "")
         self._start_gcs()
 
     # ------------------------------------------------------------------
@@ -69,11 +75,30 @@ class Cluster:
     def _start_gcs(self) -> None:
         import socket
 
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        self.gcs_port = s.getsockname()[1]
-        s.close()
-        self.gcs_proc = spawn_gcs(self.gcs_port, self.session_dir)
+        if self.gcs_port is None:
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            self.gcs_port = s.getsockname()[1]
+            s.close()
+        old = config.gcs_storage_path
+        try:
+            if self.gcs_storage_path:
+                config.gcs_storage_path = self.gcs_storage_path
+            self.gcs_proc = spawn_gcs(self.gcs_port, self.session_dir)
+        finally:
+            config.gcs_storage_path = old
+
+    def kill_gcs(self) -> None:
+        """Kill the GCS process (simulating a control-plane crash)."""
+        if self.gcs_proc is not None:
+            kill_process_tree(self.gcs_proc, force=True)
+            self.gcs_proc = None
+
+    def restart_gcs(self) -> None:
+        """Restart the GCS on the SAME port; with gcs_storage it replays
+        its persisted tables and raylets re-register via heartbeats."""
+        self.kill_gcs()
+        self._start_gcs()
 
     # ------------------------------------------------------------------
     def add_node(

@@ -1,0 +1,102 @@
+"""GCS fault tolerance (reference: GCS restart replaying gcs_init_data
+from Redis; raylets NotifyGCSRestart): the control plane restarts on the
+same port with file-backed state, raylets re-register via heartbeats,
+and named/detached actors, KV entries, and pending work survive."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.rpc import RpcClient
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def persistent_cluster():
+    cluster = Cluster(gcs_storage=True)
+    cluster.add_node(num_cpus=4)
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address)
+    yield cluster
+    try:
+        ray_tpu.shutdown()
+    except Exception:
+        pass
+    cluster.shutdown()
+
+
+def _wait_nodes_alive(cluster, n, timeout=60):
+    client = RpcClient("127.0.0.1", cluster.gcs_port)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            infos = client.call("GetAllNodeInfo", timeout=5)
+            if sum(1 for i in infos if i["Alive"]) >= n:
+                return
+        except Exception:
+            pass
+        time.sleep(0.3)
+    raise AssertionError("nodes did not re-register after GCS restart")
+
+
+def test_state_survives_restart(persistent_cluster):
+    cluster = persistent_cluster
+
+    @ray_tpu.remote
+    class Registry:
+        def __init__(self):
+            self.items = {}
+
+        def put(self, k, v):
+            self.items[k] = v
+            return True
+
+        def get(self, k):
+            return self.items.get(k)
+
+    reg = Registry.options(name="registry", lifetime="detached").remote()
+    assert ray_tpu.get(reg.put.remote("a", 1))
+    # KV via the public experimental surface: use the GCS directly
+    gcs = RpcClient("127.0.0.1", cluster.gcs_port)
+    gcs.call("KVPut", ns="user", key="k1", value=b"v1", overwrite=True,
+             timeout=10)
+    time.sleep(1.5)  # let the snapshot flush (0.5s loop)
+
+    cluster.restart_gcs()
+    _wait_nodes_alive(cluster, 1)
+
+    gcs2 = RpcClient("127.0.0.1", cluster.gcs_port)
+    # KV replayed
+    assert gcs2.call("KVGet", ns="user", key="k1", timeout=10) == b"v1"
+    # named detached actor replayed AND still serving (its worker never
+    # died — only the control plane did)
+    h = ray_tpu.get_actor("registry")
+    assert ray_tpu.get(h.get.remote("a"), timeout=60) == 1
+    # new work schedules normally after the restart
+    @ray_tpu.remote
+    def f(x):
+        return x * 3
+
+    assert ray_tpu.get(f.remote(7), timeout=60) == 21
+
+
+def test_pending_actor_scheduled_after_restart(persistent_cluster):
+    cluster = persistent_cluster
+
+    # an actor whose resources don't exist yet stays PENDING
+    @ray_tpu.remote(resources={"special": 1})
+    class Special:
+        def ping(self):
+            return "pong"
+
+    a = Special.options(name="special_actor", lifetime="detached").remote()
+    time.sleep(1.5)  # snapshot the PENDING actor
+
+    cluster.restart_gcs()
+    _wait_nodes_alive(cluster, 1)
+    # add a node carrying the resource — the REPLAYED pending actor must
+    # get scheduled onto it
+    cluster.add_node(num_cpus=1, resources={"special": 1})
+    h = ray_tpu.get_actor("special_actor")
+    assert ray_tpu.get(h.ping.remote(), timeout=90) == "pong"
