@@ -42,6 +42,12 @@ from ray_tpu._private.worker import (
     shutdown,
     wait,
 )
+from ray_tpu._private.profiling import (
+    start_tpu_profile,
+    stop_tpu_profile,
+    timeline,
+    tpu_profile,
+)
 from ray_tpu.actor import ActorClass, ActorHandle, method
 from ray_tpu.remote_function import RemoteFunction
 from ray_tpu.runtime_context import get_runtime_context
@@ -107,6 +113,10 @@ def remote(*args, **kwargs):
 __all__ = [
     "__version__",
     "init",
+    "timeline",
+    "tpu_profile",
+    "start_tpu_profile",
+    "stop_tpu_profile",
     "shutdown",
     "is_initialized",
     "remote",

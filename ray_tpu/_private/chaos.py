@@ -1,0 +1,120 @@
+"""Chaos utilities — process-level fault injection for tests.
+
+Reference: python/ray/_private/test_utils.py:1355 (`ResourceKillerActor`
+/ `NodeKillerBase` used by python/ray/tests/chaos/ and the nightly
+chaos suite). RPC-level injection lives in _private/rpc.py
+(`testing_rpc_failure`, mirroring src/ray/rpc/rpc_chaos.h).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import List, Optional
+
+import psutil
+
+
+def list_worker_pids(raylet_pid: int) -> List[int]:
+    """PIDs of worker processes owned by a raylet."""
+    out = []
+    try:
+        parent = psutil.Process(raylet_pid)
+        for child in parent.children(recursive=True):
+            try:
+                cmd = " ".join(child.cmdline())
+            except psutil.Error:
+                continue
+            if "default_worker" in cmd:
+                out.append(child.pid)
+    except psutil.Error:
+        pass
+    return out
+
+
+def kill_random_worker(cluster, rng: Optional[random.Random] = None) -> Optional[int]:
+    """SIGKILL one random worker process somewhere in the cluster;
+    returns its pid (None if no workers are running)."""
+    rng = rng or random.Random()
+    pids: List[int] = []
+    for node in cluster.nodes:
+        pids.extend(list_worker_pids(node.proc.pid))
+    if not pids:
+        return None
+    victim = rng.choice(pids)
+    try:
+        psutil.Process(victim).kill()
+        return victim
+    except psutil.Error:
+        return None
+
+
+class WorkerKiller:
+    """Background thread killing a random worker every ``interval_s``
+    (reference: ResourceKillerActor, test_utils.py:1355)."""
+
+    def __init__(self, cluster, interval_s: float = 1.0,
+                 max_kills: int = 1_000_000, seed: int = 0):
+        self.cluster = cluster
+        self.interval_s = interval_s
+        self.max_kills = max_kills
+        self.kills = 0
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        def _loop():
+            while not self._stop.wait(self.interval_s) and \
+                    self.kills < self.max_kills:
+                if kill_random_worker(self.cluster, self._rng) is not None:
+                    self.kills += 1
+
+        self._thread = threading.Thread(target=_loop, daemon=True,
+                                        name="chaos-worker-killer")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+class NodeKiller:
+    """Kills random NON-HEAD nodes of a cluster_utils.Cluster at an
+    interval (reference: NodeKillerBase, test_utils.py:1451)."""
+
+    def __init__(self, cluster, interval_s: float = 5.0, max_kills: int = 1,
+                 seed: int = 0):
+        self.cluster = cluster
+        self.interval_s = interval_s
+        self.max_kills = max_kills
+        self.killed: List[str] = []
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def kill_one(self) -> Optional[str]:
+        candidates = [n for n in self.cluster.nodes if not n.is_head]
+        if not candidates:
+            return None
+        victim = self._rng.choice(candidates)
+        self.cluster.remove_node(victim)
+        self.killed.append(victim.node_id)
+        return victim.node_id
+
+    def start(self) -> None:
+        def _loop():
+            while not self._stop.wait(self.interval_s) and \
+                    len(self.killed) < self.max_kills:
+                self.kill_one()
+
+        self._thread = threading.Thread(target=_loop, daemon=True,
+                                        name="chaos-node-killer")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)

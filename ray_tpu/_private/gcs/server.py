@@ -144,7 +144,7 @@ class GcsServer:
         except Exception:
             logger.exception("failed to load persisted state")
             return
-        if isinstance(snap, dict) and "kv" in snap:
+        if isinstance(snap, dict) and "kv" in snap and "actors" in snap:
             self.kv = snap["kv"]
             self._load_blobs()
             self.actors = snap.get("actors", {})
@@ -495,6 +495,11 @@ class GcsServer:
 
     async def KVDel(self, ns: str, key: str) -> dict:
         self.kv.get(ns, {}).pop(key, None)
+        if ns in self._BLOB_NAMESPACES and self.storage_path:
+            try:
+                os.unlink(os.path.join(self._blob_dir(), ns + "." + key))
+            except OSError:
+                pass
         self._persist(immediate=True)
         return {"ok": True}
 
@@ -524,9 +529,14 @@ class GcsServer:
         cpu_scheduling_only: bool = False,
         runtime_env_hash: str = "",
     ) -> dict:
+        # idempotent retry: a caller re-sending after a lost reply (GCS
+        # crash post-persist, or chaos response drop) must not create a
+        # second instance or see a spurious name conflict
+        if actor_id in self.actors:
+            return {"actor_id": actor_id, "existing": True}
         if name:
             existing = self.named_actors.get((namespace, name))
-            if existing is not None:
+            if existing is not None and existing != actor_id:
                 ex = self.actors.get(existing)
                 if ex is not None and ex.state != "DEAD":
                     if get_if_exists:
@@ -813,6 +823,8 @@ class GcsServer:
         strategy: str,
         creator_job: str = "",
     ) -> dict:
+        if pg_id in self.placement_groups:
+            return {"pg_id": pg_id}
         pg = PlacementGroupInfo(
             pg_id=pg_id,
             name=name,

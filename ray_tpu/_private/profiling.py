@@ -1,0 +1,99 @@
+"""Profiling: task timeline export + TPU (jax.profiler) hooks.
+
+Reference: python/ray/_private/profiling.py (`ray.timeline` → Chrome
+trace of task lifetimes from GcsTaskManager events) and the runtime-env
+GPU profiler plugins (_private/runtime_env/nsight.py) — the TPU
+equivalent wraps jax.profiler/xprof traces.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+
+def timeline(filename: Optional[str] = None) -> Optional[List[Dict[str, Any]]]:
+    """Chrome-trace events of task execution (open in chrome://tracing
+    or Perfetto). Spans: queued (SUBMITTED→RUNNING) and execution
+    (RUNNING→FINISHED/FAILED); tasks missing a RUNNING event fall back
+    to one SUBMITTED→end span.
+
+    Reference surface: ray.timeline(_private/profiling.py).
+    """
+    from ray_tpu.util.state import list_tasks
+
+    by_task: Dict[str, Dict[str, dict]] = {}
+    for ev in list_tasks(limit=20000):
+        by_task.setdefault(ev["task_id"], {})[ev["state"]] = ev
+    events: List[Dict[str, Any]] = []
+    for tid, states in by_task.items():
+        sub = states.get("SUBMITTED")
+        run = states.get("RUNNING")
+        end = states.get("FINISHED") or states.get("FAILED")
+        name = (end or run or sub or {}).get("name", "?")
+        failed = "FAILED" in states
+        if sub and run:
+            events.append({
+                "name": f"queued:{name}", "cat": "queue", "ph": "X",
+                "ts": sub["ts"] * 1e6,
+                "dur": max(0.0, (run["ts"] - sub["ts"]) * 1e6),
+                "pid": sub.get("job_id", "job"),
+                "tid": run.get("worker", "worker"),
+                "args": {"task_id": tid},
+            })
+        start = run or sub
+        if start and end:
+            events.append({
+                "name": name, "cat": "task", "ph": "X",
+                "ts": start["ts"] * 1e6,
+                "dur": max(0.0, (end["ts"] - start["ts"]) * 1e6),
+                "pid": start.get("job_id", "job"),
+                "tid": (run or end).get("worker", "worker"),
+                "args": {"task_id": tid, "state": end["state"],
+                         "failed": failed},
+            })
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(events, f)
+        return None
+    return events
+
+
+# ---------------------------------------------------------------------------
+# TPU device profiling (jax.profiler / xprof)
+# ---------------------------------------------------------------------------
+_trace_active = False
+
+
+def start_tpu_profile(logdir: str) -> None:
+    """Start a jax.profiler trace (view in XProf/TensorBoard). The TPU
+    analogue of the reference's GPU profiler runtime-env plugins."""
+    global _trace_active
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    _trace_active = True
+
+
+def stop_tpu_profile() -> None:
+    global _trace_active
+    import jax
+
+    if _trace_active:
+        jax.profiler.stop_trace()
+        _trace_active = False
+
+
+class tpu_profile:
+    """Context manager: ``with ray_tpu.tpu_profile("/tmp/trace"): step()``"""
+
+    def __init__(self, logdir: str):
+        self.logdir = logdir
+
+    def __enter__(self):
+        start_tpu_profile(self.logdir)
+        return self
+
+    def __exit__(self, *exc):
+        stop_tpu_profile()
+        return False

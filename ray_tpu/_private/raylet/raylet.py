@@ -139,6 +139,7 @@ class Raylet:
         self.leases: Dict[str, Lease] = {}
         self.pending: List[PendingLease] = []
         self.autoscaling_enabled = False
+        self._pending_death_notices: List[dict] = []
         # placement group bundles: (pg_id, bundle_index) -> alloc
         self.prepared_bundles: Dict[Tuple[str, int], Dict[str, Any]] = {}
         self.committed_bundles: Dict[Tuple[str, int], "ResourceSet"] = {}
@@ -971,17 +972,27 @@ class Raylet:
                         except ValueError:
                             pass
                     if addr is not None:
-                        try:
-                            await self.gcs.acall(
-                                "NotifyWorkerDeath",
-                                node_id=self.node_id,
-                                worker_id=w.worker_id,
-                                worker_addr=addr,
-                                timeout=10,
-                            )
-                        except Exception:
-                            pass
+                        # queued, not fire-and-forget: a death during GCS
+                        # downtime must still be delivered after the GCS
+                        # restarts, or replayed ALIVE actors point at dead
+                        # workers forever
+                        self._pending_death_notices.append({
+                            "node_id": self.node_id,
+                            "worker_id": w.worker_id,
+                            "worker_addr": addr,
+                        })
+            await self._flush_death_notices()
             self._kick_drain()
+
+    async def _flush_death_notices(self) -> None:
+        while self._pending_death_notices:
+            notice = self._pending_death_notices[0]
+            try:
+                await self.gcs.acall(
+                    "NotifyWorkerDeath", timeout=10, **notice)
+            except Exception:  # noqa: BLE001
+                return  # GCS unreachable — retried next reap tick
+            self._pending_death_notices.pop(0)
 
     async def _log_tail_loop(self) -> None:
         """Tail this node's worker log files and push appended lines to the

@@ -48,20 +48,34 @@ class RemoteError(RpcError):
         super().__init__(message)
 
 
-def _chaos_should_fail(method: str) -> bool:
+def _chaos_action(method: str) -> Optional[str]:
+    """Parse ``testing_rpc_failure`` and roll the dice for one call.
+
+    Spec: comma list of ``Method=prob[:kind]`` where kind is
+    ``request`` (drop before the handler runs — the default),
+    ``response`` (handler runs, reply is dropped — side effects happen,
+    the caller sees a timeout), or ``delay:<ms>`` (in-flight latency).
+    Mirrors the reference's Request/Response/InFlight failure kinds
+    (src/ray/rpc/rpc_chaos.h:8).
+    """
     spec = config.testing_rpc_failure
     if not spec:
-        return False
+        return None
     for part in spec.split(","):
         if "=" not in part:
             continue
-        name, prob = part.split("=", 1)
-        if name == method or name == "*":
-            try:
-                return random.random() < float(prob)
-            except ValueError:
-                return False
-    return False
+        name, rest = part.split("=", 1)
+        if name != method and name != "*":
+            continue
+        bits = rest.split(":", 1)
+        try:
+            prob = float(bits[0])
+        except ValueError:
+            return None
+        if random.random() < prob:
+            return bits[1] if len(bits) > 1 else "request"
+        return None
+    return None
 
 
 async def _read_frame(reader: asyncio.StreamReader) -> Tuple[int, int, bytes]:
@@ -195,9 +209,14 @@ class RpcServer:
         method = "?"
         try:
             method, kwargs = pickle.loads(body)
-            if _chaos_should_fail(method):
+            chaos = _chaos_action(method)
+            if chaos == "request":
                 logger.warning("chaos: dropping rpc %s", method)
                 return  # simulate lost request
+            if chaos and chaos.startswith("delay"):
+                ms = float(chaos.split(":", 1)[1]) if ":" in chaos else 100.0
+                logger.warning("chaos: delaying rpc %s by %sms", method, ms)
+                await asyncio.sleep(ms / 1000.0)
             handler = self._handlers.get(method)
             if handler is None:
                 raise RpcError(f"{self.name}: no handler for {method!r}")
@@ -207,6 +226,10 @@ class RpcServer:
                 result = await asyncio.get_event_loop().run_in_executor(
                     None, lambda: handler(**kwargs)
                 )
+            if chaos == "response":
+                # handler side effects happened; the reply is lost
+                logger.warning("chaos: dropping reply of rpc %s", method)
+                return
             if kind == KIND_ONEWAY:
                 return
             payload = pickle.dumps((True, result), protocol=5)

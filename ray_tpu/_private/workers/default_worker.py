@@ -228,6 +228,14 @@ def _execute_callable(
 
     w = worker_mod.global_worker
     w.set_task_context(task_id, actor_id)
+    # execution start: gives the timeline its queued-vs-running split
+    # (reference: task_event_buffer.h RUNNING state transition)
+    try:
+        w.core._record_task_event(
+            task_id, name, "RUNNING",
+            kind="actor_task" if actor_id else "task")
+    except Exception:  # noqa: BLE001
+        pass
     all_borrows: List[tuple] = []  # every AddBorrower sent for this task
     try:
         args, kwargs = _resolve_args(packed_args, packed_kwargs)

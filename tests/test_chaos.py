@@ -1,0 +1,118 @@
+"""Chaos tests (reference strategy: python/ray/tests/chaos/ + the RPC
+fault injection of rpc_chaos.h): the cluster must make progress under
+dropped requests, dropped replies, injected latency, and killed worker
+processes."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.config import config
+
+
+@pytest.fixture
+def chaos_cluster():
+    """Single-node cluster whose daemons inherit the chaos spec set in
+    config BEFORE the fixture runs (propagates via RAY_TPU_CONFIG_JSON)."""
+    yield
+    config.testing_rpc_failure = ""
+    try:
+        ray_tpu.shutdown()
+    except Exception:
+        pass
+
+
+def _run_workload(n=30, retries=3):
+    @ray_tpu.remote(max_retries=retries)
+    def f(x):
+        return x * x
+
+    return ray_tpu.get([f.remote(i) for i in range(n)], timeout=240)
+
+
+class TestRpcChaos:
+    def test_dropped_lease_requests_retry(self, chaos_cluster):
+        config.testing_rpc_failure = "RequestWorkerLease=0.3"
+        ray_tpu.init(num_cpus=4)
+        assert _run_workload(30) == [i * i for i in range(30)]
+
+    def test_dropped_replies_are_survivable(self, chaos_cluster):
+        # Heartbeat replies lost 20% of the time: the raylet must keep
+        # functioning (reference Response failure kind)
+        config.testing_rpc_failure = "Heartbeat=0.2:response"
+        ray_tpu.init(num_cpus=4)
+        assert _run_workload(20) == [i * i for i in range(20)]
+
+    def test_injected_latency(self, chaos_cluster):
+        config.testing_rpc_failure = "GetObject=0.5:delay:200"
+        ray_tpu.init(num_cpus=4)
+        assert _run_workload(10) == [i * i for i in range(10)]
+
+
+class TestProcessChaos:
+    def test_workload_survives_worker_kills(self):
+        from ray_tpu._private.chaos import WorkerKiller, kill_random_worker
+        from ray_tpu.cluster_utils import Cluster
+
+        cluster = Cluster()
+        cluster.add_node(num_cpus=4)
+        cluster.wait_for_nodes()
+        try:
+            ray_tpu.init(address=cluster.address)
+
+            @ray_tpu.remote(max_retries=5)
+            def slow(x):
+                import time as _t
+
+                _t.sleep(0.3)
+                return x + 1
+
+            killer = WorkerKiller(cluster, interval_s=0.7, max_kills=3)
+            futs = [slow.remote(i) for i in range(24)]
+            killer.start()
+            try:
+                out = ray_tpu.get(futs, timeout=240)
+            finally:
+                killer.stop()
+            assert out == [i + 1 for i in range(24)]
+            assert killer.kills >= 1  # chaos actually happened
+        finally:
+            try:
+                ray_tpu.shutdown()
+            except Exception:
+                pass
+            cluster.shutdown()
+
+    def test_workload_survives_node_kill(self):
+        from ray_tpu._private.chaos import NodeKiller
+        from ray_tpu.cluster_utils import Cluster
+
+        cluster = Cluster()
+        cluster.add_node(num_cpus=2)
+        cluster.add_node(num_cpus=2)
+        cluster.wait_for_nodes()
+        try:
+            ray_tpu.init(address=cluster.address)
+
+            @ray_tpu.remote(max_retries=5)
+            def slow(x):
+                import time as _t
+
+                _t.sleep(0.25)
+                return x * 10
+
+            futs = [slow.remote(i) for i in range(16)]
+            time.sleep(0.8)  # let work spread onto the worker node
+            killer = NodeKiller(cluster, max_kills=1)
+            killed = killer.kill_one()
+            assert killed is not None
+            out = ray_tpu.get(futs, timeout=240)
+            assert out == [i * 10 for i in range(16)]
+        finally:
+            try:
+                ray_tpu.shutdown()
+            except Exception:
+                pass
+            cluster.shutdown()
