@@ -66,6 +66,15 @@ from ray_tpu.exceptions import (
 logger = logging.getLogger(__name__)
 
 
+class _InfeasibleStrategyError(Exception):
+    """A hard scheduling-strategy constraint can never be satisfied."""
+
+
+class _TransientSchedulingError(Exception):
+    """The node view is unavailable right now (GCS blip) — retry, don't
+    fail the tasks."""
+
+
 class _LeaseEntry:
     __slots__ = ("lease_id", "worker_addr", "busy", "last_used", "raylet_addr")
 
@@ -361,6 +370,10 @@ class CoreWorker(CoreRuntime):
         self.server.register("Ping", lambda: "pong")
         self.server.start(self.loop_thread)
         self.address: Tuple[str, int] = (self.server.host, self.server.port)
+
+        # scheduling-strategy state
+        self._node_view_cache: Optional[Tuple[float, List[dict]]] = None
+        self._spread_rr = -1
 
         # task submission state
         self._lock = threading.Lock()
@@ -1282,6 +1295,69 @@ class CoreWorker(CoreRuntime):
             return
         await self._push_task(spec, lease)
 
+    # -- scheduling strategies (reference: scheduling policies under
+    # src/ray/raylet/scheduling/policy/ — node-affinity, spread, labels;
+    # hybrid top-k lives in the raylet's spillback picker) -------------
+    async def _node_view(self) -> List[dict]:
+        """Alive nodes from the GCS, cached briefly (lease requests are
+        off the task hot path, but SPREAD shouldn't hammer the GCS).
+        Raises _TransientSchedulingError when the GCS is unreachable and
+        no cache exists — a control-plane blip must not read as 'node
+        dead' to a hard affinity/label constraint."""
+        now = time.monotonic()
+        cached = self._node_view_cache
+        if cached and now - cached[0] < 2.0:
+            return cached[1]
+        try:
+            infos = await self.gcs.acall("GetAllNodeInfo", timeout=10)
+        except Exception as e:  # noqa: BLE001
+            if cached:
+                return cached[1]
+            raise _TransientSchedulingError(str(e)) from None
+        alive = [n for n in infos if n.get("Alive")]
+        self._node_view_cache = (now, alive)
+        return alive
+
+    async def _lease_target(self, strategy) -> Tuple[Tuple[str, int], bool]:
+        """(raylet addr to lease from, allow_spillback) per strategy."""
+        kind = strategy.kind
+        if kind == "NODE_AFFINITY":
+            for n in await self._node_view():
+                if n["NodeID"] == strategy.node_id:
+                    return ((n["NodeManagerAddress"],
+                             n["NodeManagerPort"]), bool(strategy.soft))
+            if strategy.soft:
+                return self.raylet_addr, True
+            raise _InfeasibleStrategyError(
+                f"node {strategy.node_id!r} is not alive "
+                f"(NodeAffinity soft=False)")
+        if kind == "SPREAD":
+            nodes = await self._node_view()
+            if nodes:
+                self._spread_rr += 1
+                n = nodes[self._spread_rr % len(nodes)]
+                return ((n["NodeManagerAddress"],
+                         n["NodeManagerPort"]), True)
+        if kind == "NODE_LABEL":
+            hard = strategy.node_labels or {}
+            matches = [
+                n for n in await self._node_view()
+                if all(n.get("Labels", {}).get(k) == v
+                       for k, v in hard.items())
+            ]
+            if matches:
+                # least loaded by available CPU
+                n = max(matches, key=lambda m:
+                        m.get("AvailableResources", {}).get("CPU", 0.0))
+                return ((n["NodeManagerAddress"],
+                         n["NodeManagerPort"]), False)
+            if strategy.soft:
+                return self.raylet_addr, True
+            raise _InfeasibleStrategyError(
+                f"no alive node matches labels {hard!r} "
+                f"(NodeLabel soft=False)")
+        return self.raylet_addr, True
+
     async def _maybe_request_lease(self, sc, spec: TaskSpec) -> None:
         with self._lock:
             inflight = self._lease_requests_inflight.get(sc, 0)
@@ -1301,15 +1377,31 @@ class CoreWorker(CoreRuntime):
                 timeout=config.worker_lease_timeout_ms / 1000.0 + 10.0,
                 runtime_env_hash=spec.runtime_env_hash(),
             )
-            granted_by: Tuple[str, int] = self.raylet_addr
-            reply = await self.raylet.acall("RequestWorkerLease", **kwargs)
+            try:
+                target_addr, allow_spill = await self._lease_target(strategy)
+            except _InfeasibleStrategyError as e:
+                err = RayTaskError(
+                    spec.function_descriptor.repr_name, str(e))
+                self._fail_queued_tasks(sc, err)
+                return
+            except _TransientSchedulingError as e:
+                # GCS blip with a cold node-view cache: the not-granted
+                # path below re-kicks the request — the constraint might
+                # be perfectly satisfiable
+                raise RuntimeError(f"node view unavailable: {e}") from None
+            kwargs["allow_spillback"] = allow_spill
+            client = self.raylet if tuple(target_addr) == tuple(
+                self.raylet_addr) else get_client(tuple(target_addr))
+            granted_by: Tuple[str, int] = tuple(target_addr)
+            reply = await client.acall("RequestWorkerLease", **kwargs)
             if reply.get("spillback"):
                 # local raylet redirected us to a node with capacity
                 # (reference: normal_task_submitter.cc:413 re-request at the
                 # spillback node); a spilled request cannot spill again
                 granted_by = tuple(reply["spillback"])
                 reply = await get_client(granted_by).acall(
-                    "RequestWorkerLease", allow_spillback=False, **kwargs
+                    "RequestWorkerLease",
+                    **dict(kwargs, allow_spillback=False),
                 )
         except Exception as e:  # noqa: BLE001
             if not self._shutdown:
@@ -1780,6 +1872,10 @@ class CoreWorker(CoreRuntime):
             bundle_index=strategy.placement_group_bundle_index,
             cpu_scheduling_only=opts.cpu_scheduling_only,
             runtime_env_hash=actor_env_hash,
+            scheduling_kind=strategy.kind,
+            affinity_node_id=strategy.node_id,
+            strategy_soft=strategy.soft,
+            node_labels=strategy.node_labels,
         )
         if "error" in reply:
             raise ValueError(reply["error"])

@@ -76,6 +76,11 @@ class ActorInfo:
     lease_in_flight: bool = False
     # workers tainted by a runtime env are dedicated to it
     runtime_env_hash: str = ""
+    # scheduling strategy (reference: node-affinity / node-label policies)
+    scheduling_kind: str = "DEFAULT"
+    affinity_node_id: Optional[str] = None
+    strategy_soft: bool = False
+    node_labels: Optional[Dict[str, str]] = None
 
 
 
@@ -528,6 +533,10 @@ class GcsServer:
         bundle_index: int = -1,
         cpu_scheduling_only: bool = False,
         runtime_env_hash: str = "",
+        scheduling_kind: str = "DEFAULT",
+        affinity_node_id: Optional[str] = None,
+        strategy_soft: bool = False,
+        node_labels: Optional[Dict[str, str]] = None,
     ) -> dict:
         # idempotent retry: a caller re-sending after a lost reply (GCS
         # crash post-persist, or chaos response drop) must not create a
@@ -557,6 +566,10 @@ class GcsServer:
             bundle_index=bundle_index,
             cpu_scheduling_only=cpu_scheduling_only,
             runtime_env_hash=runtime_env_hash,
+            scheduling_kind=scheduling_kind,
+            affinity_node_id=affinity_node_id,
+            strategy_soft=strategy_soft,
+            node_labels=dict(node_labels) if node_labels else None,
         )
         self.actors[actor_id] = actor
         if name:
@@ -565,9 +578,11 @@ class GcsServer:
         asyncio.ensure_future(self._schedule_actor(actor))
         return {"actor_id": actor_id, "existing": False}
 
-    def _pick_node_for(self, resources: Dict[str, float], pg: Optional[PlacementGroupInfo], bundle_index: int) -> Optional[str]:
+    def _pick_node_for(self, resources: Dict[str, float], pg: Optional[PlacementGroupInfo], bundle_index: int,
+                       actor: Optional[ActorInfo] = None) -> Optional[str]:
         """GCS-side actor scheduling (reference: GcsActorScheduler
-        gcs_actor_scheduler.h:104 — uses cluster resource view)."""
+        gcs_actor_scheduler.h:104 — uses cluster resource view); honors
+        the actor's node-affinity / node-label strategy."""
         if pg is not None:
             if bundle_index >= 0:
                 return pg.bundle_nodes.get(bundle_index)
@@ -577,19 +592,33 @@ class GcsServer:
                 if node and node.alive:
                     return nid
             return None
+
+        def _matches(n: NodeInfo) -> bool:
+            if actor is None:
+                return True
+            if actor.scheduling_kind == "NODE_AFFINITY":
+                return n.node_id == actor.affinity_node_id
+            if actor.scheduling_kind == "NODE_LABEL":
+                return all(n.labels.get(k) == v
+                           for k, v in (actor.node_labels or {}).items())
+            return True
+
+        alive = [n for n in self.nodes.values() if n.alive]
+        allowed = [n for n in alive if _matches(n)]
+        if not allowed and actor is not None and actor.strategy_soft:
+            allowed = alive  # soft constraint: fall back to anywhere
         candidates = []
-        for n in self.nodes.values():
-            if not n.alive:
-                continue
+        for n in allowed:
             if all(n.available_resources.get(k, 0.0) >= v for k, v in resources.items()):
                 # least-loaded first: fewest live actors already placed there
                 load = sum(1 for a in self.actors.values()
                            if a.node_id == n.node_id and a.state != "DEAD")
                 candidates.append((load, n.node_id))
         if not candidates:
-            # fall back: any node whose *total* resources fit (may queue)
-            for n in self.nodes.values():
-                if n.alive and all(n.total_resources.get(k, 0.0) >= v for k, v in resources.items()):
+            # fall back: any ALLOWED node whose *total* resources fit
+            # (may queue behind current occupants)
+            for n in allowed:
+                if all(n.total_resources.get(k, 0.0) >= v for k, v in resources.items()):
                     return n.node_id
             return None
         candidates.sort()
@@ -604,7 +633,8 @@ class GcsServer:
             if actor.state == "DEAD":
                 return
             pg = self.placement_groups.get(actor.pg_id) if actor.pg_id else None
-            node_id = self._pick_node_for(actor.resources, pg, actor.bundle_index)
+            node_id = self._pick_node_for(actor.resources, pg,
+                                          actor.bundle_index, actor=actor)
             if node_id is None:
                 await asyncio.sleep(0.2)
                 continue

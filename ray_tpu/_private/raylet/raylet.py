@@ -140,6 +140,7 @@ class Raylet:
         self.pending: List[PendingLease] = []
         self.autoscaling_enabled = False
         self._pending_death_notices: List[dict] = []
+        self._death_flush_running = False
         # placement group bundles: (pg_id, bundle_index) -> alloc
         self.prepared_bundles: Dict[Tuple[str, int], Dict[str, Any]] = {}
         self.committed_bundles: Dict[Tuple[str, int], "ResourceSet"] = {}
@@ -388,11 +389,13 @@ class Raylet:
     def _pick_spillback(
         self, resources: Dict[str, float], require_available: bool
     ) -> Optional[Tuple[str, int]]:
-        """Pick another node's raylet address for lease spillback, preferring
-        the most free CPU (reference: hybrid_scheduling_policy.h top-k; we
-        rank by availability over the heartbeat-synced cluster view)."""
-        best_score = None
-        best_addr = None
+        """Pick another node's raylet for lease spillback: rank candidates
+        by availability, then choose RANDOMLY among the top-k (reference:
+        hybrid_scheduling_policy.h:29-46 — the top-k jitter stops every
+        node in the cluster from herding onto one 'best' target)."""
+        import random as _random
+
+        candidates = []
         for nid, info in self.cluster_view.items():
             if nid == self.node_id or not info.get("alive"):
                 continue
@@ -404,10 +407,13 @@ class Raylet:
             if require_available and not has_now:
                 continue
             score = (1 if has_now else 0, avail.get("CPU", 0.0))
-            if best_score is None or score > best_score:
-                best_score = score
-                best_addr = tuple(info["addr"])
-        return best_addr
+            candidates.append((score, tuple(info["addr"])))
+        if not candidates:
+            return None
+        candidates.sort(key=lambda c: c[0], reverse=True)
+        k = max(config.scheduler_top_k_absolute,
+                int(len(candidates) * config.scheduler_top_k_fraction))
+        return _random.choice(candidates[:max(1, k)])[1]
 
     def _resource_set_for(self, req: dict) -> Tuple[ResourceSet, Optional[Tuple[str, int]]]:
         """Returns (resource_set, committed_bundle_key). The key is the
@@ -981,18 +987,25 @@ class Raylet:
                             "worker_id": w.worker_id,
                             "worker_addr": addr,
                         })
-            await self._flush_death_notices()
+            if self._pending_death_notices and not self._death_flush_running:
+                # background task with a short timeout: a hung GCS must
+                # not stall the reap loop's death detection
+                asyncio.ensure_future(self._flush_death_notices())
             self._kick_drain()
 
     async def _flush_death_notices(self) -> None:
-        while self._pending_death_notices:
-            notice = self._pending_death_notices[0]
-            try:
-                await self.gcs.acall(
-                    "NotifyWorkerDeath", timeout=10, **notice)
-            except Exception:  # noqa: BLE001
-                return  # GCS unreachable — retried next reap tick
-            self._pending_death_notices.pop(0)
+        self._death_flush_running = True
+        try:
+            while self._pending_death_notices:
+                notice = self._pending_death_notices[0]
+                try:
+                    await self.gcs.acall(
+                        "NotifyWorkerDeath", timeout=3, **notice)
+                except Exception:  # noqa: BLE001
+                    return  # GCS unreachable — retried next reap tick
+                self._pending_death_notices.pop(0)
+        finally:
+            self._death_flush_running = False
 
     async def _log_tail_loop(self) -> None:
         """Tail this node's worker log files and push appended lines to the

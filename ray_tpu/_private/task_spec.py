@@ -59,12 +59,13 @@ class SchedulingStrategy:
     """DEFAULT | SPREAD | node-affinity | placement-group (reference:
     python/ray/util/scheduling_strategies.py)."""
 
-    kind: str = "DEFAULT"  # DEFAULT, SPREAD, NODE_AFFINITY, PLACEMENT_GROUP
+    kind: str = "DEFAULT"  # DEFAULT, SPREAD, NODE_AFFINITY, NODE_LABEL, PLACEMENT_GROUP
     node_id: Optional[str] = None
     soft: bool = False
     placement_group_id: Optional[str] = None
     placement_group_bundle_index: int = -1
     placement_group_capture_child_tasks: bool = False
+    node_labels: Optional[Dict[str, str]] = None
 
 
 @dataclass
@@ -110,12 +111,16 @@ class TaskSpec:
         (reference: SchedulingClass in src/ray/common/task/task_spec.h —
         the reference's class includes the runtime env so leased workers
         are never shared across envs)."""
+        st = self.scheduling_strategy
         return (
             self.function_descriptor.key(),
             tuple(sorted(self.resources.items())),
-            self.scheduling_strategy.kind,
-            self.scheduling_strategy.placement_group_id,
-            self.scheduling_strategy.placement_group_bundle_index,
+            st.kind,
+            st.placement_group_id,
+            st.placement_group_bundle_index,
+            # affinity/label targets must not share leases across targets
+            st.node_id,
+            tuple(sorted((st.node_labels or {}).items())),
             self.runtime_env_hash(),
         )
 

@@ -128,6 +128,7 @@ class Cluster:
             store_capacity=int(object_store_memory or config.object_store_memory_bytes),
             session_dir=node_dir,
             is_head=is_head,
+            labels=labels,
         )
         node = ClusterNode(
             node_id=node_id,

@@ -13,6 +13,7 @@ from ray_tpu.util.placement_group import (
 )
 from ray_tpu.util.scheduling_strategies import (
     NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
     PlacementGroupSchedulingStrategy,
 )
 
@@ -22,6 +23,7 @@ __all__ = [
     "STRICT_PACK",
     "STRICT_SPREAD",
     "NodeAffinitySchedulingStrategy",
+    "NodeLabelSchedulingStrategy",
     "PlacementGroup",
     "PlacementGroupSchedulingStrategy",
     "placement_group",

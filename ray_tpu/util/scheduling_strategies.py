@@ -34,3 +34,21 @@ class NodeAffinitySchedulingStrategy:
 
     def to_internal(self) -> SchedulingStrategy:
         return SchedulingStrategy(kind="NODE_AFFINITY", node_id=self.node_id, soft=self.soft)
+
+
+class NodeLabelSchedulingStrategy:
+    """Schedule onto nodes whose labels match (reference:
+    scheduling_strategies.py NodeLabelSchedulingStrategy / the raylet's
+    node-label policy, scheduling/policy/node_label_scheduling_policy.h).
+
+    ``hard``: {label: value} every candidate node must carry.
+    ``soft=True`` falls back to default scheduling when nothing matches.
+    """
+
+    def __init__(self, hard: Optional[dict] = None, soft: bool = False):
+        self.hard = dict(hard or {})
+        self.soft = soft
+
+    def to_internal(self) -> SchedulingStrategy:
+        return SchedulingStrategy(kind="NODE_LABEL", node_labels=self.hard,
+                                  soft=self.soft)

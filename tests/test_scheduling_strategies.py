@@ -1,0 +1,122 @@
+"""Scheduling strategy tests (reference: scheduling policies under
+src/ray/raylet/scheduling/policy/ and
+python/ray/util/scheduling_strategies.py): node affinity (hard + soft),
+SPREAD, node labels, and top-k spillback."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
+)
+
+
+@pytest.fixture(scope="module")
+def two_node_cluster():
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2)
+    n2 = cluster.add_node(num_cpus=2, labels={"region": "eu",
+                                              "tier": "gold"})
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address)
+    yield cluster, n2
+    try:
+        ray_tpu.shutdown()
+    except Exception:
+        pass
+    cluster.shutdown()
+
+
+@ray_tpu.remote
+def where():
+    return os.environ["RAY_TPU_NODE_ID"]
+
+
+class TestNodeAffinity:
+    def test_hard_affinity_pins_to_node(self, two_node_cluster):
+        cluster, n2 = two_node_cluster
+        f = where.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(n2.node_id))
+        assert ray_tpu.get(f.remote(), timeout=90) == n2.node_id
+
+    def test_hard_affinity_to_dead_node_fails(self, two_node_cluster):
+        cluster, _ = two_node_cluster
+        f = where.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy("f" * 32))
+        with pytest.raises(Exception, match="not alive"):
+            ray_tpu.get(f.remote(), timeout=90)
+
+    def test_soft_affinity_falls_back(self, two_node_cluster):
+        f = where.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                "f" * 32, soft=True))
+        assert len(ray_tpu.get(f.remote(), timeout=90)) > 0
+
+
+class TestSpread:
+    def test_spread_uses_multiple_nodes(self, two_node_cluster):
+        @ray_tpu.remote(scheduling_strategy="SPREAD")
+        def slow_where():
+            time.sleep(0.4)
+            return os.environ["RAY_TPU_NODE_ID"]
+
+        nodes = set(ray_tpu.get(
+            [slow_where.remote() for _ in range(8)], timeout=120))
+        assert len(nodes) == 2
+
+
+class TestActorStrategies:
+    def test_actor_node_affinity(self, two_node_cluster):
+        cluster, n2 = two_node_cluster
+
+        @ray_tpu.remote
+        class Where:
+            def node(self):
+                return os.environ["RAY_TPU_NODE_ID"]
+
+        a = Where.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                n2.node_id)).remote()
+        assert ray_tpu.get(a.node.remote(), timeout=90) == n2.node_id
+        ray_tpu.kill(a)
+
+    def test_actor_node_label(self, two_node_cluster):
+        cluster, n2 = two_node_cluster
+
+        @ray_tpu.remote
+        class Where:
+            def node(self):
+                return os.environ["RAY_TPU_NODE_ID"]
+
+        a = Where.options(
+            scheduling_strategy=NodeLabelSchedulingStrategy(
+                hard={"tier": "gold"})).remote()
+        assert ray_tpu.get(a.node.remote(), timeout=90) == n2.node_id
+        ray_tpu.kill(a)
+
+
+class TestNodeLabels:
+    def test_hard_label_match(self, two_node_cluster):
+        cluster, n2 = two_node_cluster
+        f = where.options(
+            scheduling_strategy=NodeLabelSchedulingStrategy(
+                hard={"region": "eu"}))
+        assert ray_tpu.get(f.remote(), timeout=90) == n2.node_id
+
+    def test_hard_label_mismatch_fails(self, two_node_cluster):
+        f = where.options(
+            scheduling_strategy=NodeLabelSchedulingStrategy(
+                hard={"region": "mars"}))
+        with pytest.raises(Exception, match="labels"):
+            ray_tpu.get(f.remote(), timeout=90)
+
+    def test_soft_label_falls_back(self, two_node_cluster):
+        f = where.options(
+            scheduling_strategy=NodeLabelSchedulingStrategy(
+                hard={"region": "mars"}, soft=True))
+        assert len(ray_tpu.get(f.remote(), timeout=90)) > 0
