@@ -1298,7 +1298,7 @@ class CoreWorker(CoreRuntime):
     # -- scheduling strategies (reference: scheduling policies under
     # src/ray/raylet/scheduling/policy/ — node-affinity, spread, labels;
     # hybrid top-k lives in the raylet's spillback picker) -------------
-    async def _node_view(self) -> List[dict]:
+    async def _node_view(self, force: bool = False) -> List[dict]:
         """Alive nodes from the GCS, cached briefly (lease requests are
         off the task hot path, but SPREAD shouldn't hammer the GCS).
         Raises _TransientSchedulingError when the GCS is unreachable and
@@ -1306,7 +1306,7 @@ class CoreWorker(CoreRuntime):
         dead' to a hard affinity/label constraint."""
         now = time.monotonic()
         cached = self._node_view_cache
-        if cached and now - cached[0] < 2.0:
+        if not force and cached and now - cached[0] < 2.0:
             return cached[1]
         try:
             infos = await self.gcs.acall("GetAllNodeInfo", timeout=10)
@@ -1320,19 +1320,28 @@ class CoreWorker(CoreRuntime):
 
     async def _lease_target(self, strategy) -> Tuple[Tuple[str, int], bool]:
         """(raylet addr to lease from, allow_spillback) per strategy."""
+        import random as _random
+
         kind = strategy.kind
         if kind == "NODE_AFFINITY":
-            for n in await self._node_view():
-                if n["NodeID"] == strategy.node_id:
-                    return ((n["NodeManagerAddress"],
-                             n["NodeManagerPort"]), bool(strategy.soft))
+            for force in (False, True):
+                for n in await self._node_view(force=force):
+                    if n["NodeID"] == strategy.node_id:
+                        return ((n["NodeManagerAddress"],
+                                 n["NodeManagerPort"]), bool(strategy.soft))
+                # the cache can be up to 2s stale — a just-registered
+                # node must not read as dead for a HARD constraint, so
+                # re-check against a fresh view before failing
             if strategy.soft:
                 return self.raylet_addr, True
             raise _InfeasibleStrategyError(
                 f"node {strategy.node_id!r} is not alive "
                 f"(NodeAffinity soft=False)")
         if kind == "SPREAD":
-            nodes = await self._node_view()
+            try:
+                nodes = await self._node_view()
+            except _TransientSchedulingError:
+                return self.raylet_addr, True  # preference, not constraint
             if nodes:
                 self._spread_rr += 1
                 n = nodes[self._spread_rr % len(nodes)]
@@ -1340,15 +1349,22 @@ class CoreWorker(CoreRuntime):
                          n["NodeManagerPort"]), True)
         if kind == "NODE_LABEL":
             hard = strategy.node_labels or {}
-            matches = [
-                n for n in await self._node_view()
-                if all(n.get("Labels", {}).get(k) == v
-                       for k, v in hard.items())
-            ]
+
+            def _matching(view):
+                return [n for n in view
+                        if all(n.get("Labels", {}).get(k) == v
+                               for k, v in hard.items())]
+
+            matches = _matching(await self._node_view())
+            if not matches:  # stale-cache re-check before hard failure
+                matches = _matching(await self._node_view(force=True))
             if matches:
-                # least loaded by available CPU
-                n = max(matches, key=lambda m:
-                        m.get("AvailableResources", {}).get("CPU", 0.0))
+                # prefer nodes with spare CPU, pick randomly among them
+                # (a deterministic 'best' pick herds every concurrent
+                # submitter onto one matching node for the cache window)
+                free = [m for m in matches if m.get(
+                    "AvailableResources", {}).get("CPU", 0.0) > 0]
+                n = _random.choice(free or matches)
                 return ((n["NodeManagerAddress"],
                          n["NodeManagerPort"]), False)
             if strategy.soft:
@@ -1425,7 +1441,10 @@ class CoreWorker(CoreRuntime):
                     import asyncio
 
                     await asyncio.sleep(0.1)
-                    await self._maybe_request_lease(sc, spec)
+                    # fresh task, not a nested await: a long outage would
+                    # otherwise grow an unbounded coroutine await chain
+                    asyncio.ensure_future(
+                        self._maybe_request_lease(sc, spec))
             return
         entry = _LeaseEntry(reply["lease_id"], tuple(reply["worker_addr"]), granted_by)
         logger.debug("lease %s granted (worker %s)", entry.lease_id[:8], entry.worker_addr)

@@ -605,8 +605,16 @@ class GcsServer:
 
         alive = [n for n in self.nodes.values() if n.alive]
         allowed = [n for n in alive if _matches(n)]
-        if not allowed and actor is not None and actor.strategy_soft:
-            allowed = alive  # soft constraint: fall back to anywhere
+        if actor is not None and actor.strategy_soft:
+            # soft: fall back when nothing matches OR the matches can
+            # never fit the request (total resources too small)
+            fittable = [
+                n for n in allowed
+                if all(n.total_resources.get(k, 0.0) >= v
+                       for k, v in resources.items())
+            ]
+            if not fittable:
+                allowed = alive
         candidates = []
         for n in allowed:
             if all(n.available_resources.get(k, 0.0) >= v for k, v in resources.items()):
@@ -632,6 +640,22 @@ class GcsServer:
         while time.monotonic() < deadline:
             if actor.state == "DEAD":
                 return
+            # hard affinity to a node id that is registered-but-dead can
+            # never succeed (node ids are never reused) — fail fast with
+            # a precise cause instead of spinning out the 300s deadline.
+            # (Hard LABELS keep waiting: a matching node may be added,
+            # e.g. by the autoscaler.)
+            if (actor.scheduling_kind == "NODE_AFFINITY"
+                    and not actor.strategy_soft):
+                target = self.nodes.get(actor.affinity_node_id)
+                if target is not None and not target.alive:
+                    actor.state = "DEAD"
+                    actor.death_cause = (
+                        f"node {actor.affinity_node_id[:12]} is dead "
+                        f"(NodeAffinity soft=False)")
+                    actor.version += 1
+                    self._notify_actor(actor.actor_id)
+                    return
             pg = self.placement_groups.get(actor.pg_id) if actor.pg_id else None
             node_id = self._pick_node_for(actor.resources, pg,
                                           actor.bundle_index, actor=actor)
@@ -713,7 +737,15 @@ class GcsServer:
                     pass
                 return
         actor.state = "DEAD"
-        actor.death_cause = "scheduling timed out (insufficient resources?)"
+        if actor.scheduling_kind in ("NODE_AFFINITY", "NODE_LABEL") \
+                and not actor.strategy_soft:
+            actor.death_cause = (
+                f"scheduling timed out: no node satisfied the hard "
+                f"{actor.scheduling_kind} constraint "
+                f"(node_id={actor.affinity_node_id!r}, "
+                f"labels={actor.node_labels!r})")
+        else:
+            actor.death_cause = "scheduling timed out (insufficient resources?)"
         actor.version += 1
         self._notify_actor(actor.actor_id)
 

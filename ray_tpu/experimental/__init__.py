@@ -1,0 +1,6 @@
+"""ray_tpu.experimental — channels (mutable shared-memory objects) and
+other pre-stable APIs (reference: python/ray/experimental/)."""
+
+from ray_tpu.experimental.channel import Channel, ChannelReader, ChannelTimeoutError
+
+__all__ = ["Channel", "ChannelReader", "ChannelTimeoutError"]

@@ -1,0 +1,154 @@
+"""Mutable-object channels — fixed shared-memory slots rewritten in
+place for repeated host-side transfers.
+
+Reference: src/ray/core_worker/experimental_mutable_object_manager.h:44
+and python/ray/experimental/channel/shared_memory_channel.py — the
+compiled-DAG transport. A channel is ONE shm buffer with a seqlock
+header; the writer overwrites the slot each iteration and readers
+acquire/release by sequence number, so steady-state transfer does no
+allocation, no socket round-trip, and no object-store bookkeeping.
+
+Layout: [seq u64][len u64][ack_0 u64 ... ack_{R-1} u64][payload].
+Write protocol: wait until every reader's ack == seq (previous value
+consumed) → write payload, then len, then seq+1 (seq is the release
+store; x86-TSO plus the GIL make this ordering safe for CPython-level
+stores). Read protocol: wait until seq > last seen → read payload →
+store ack = seq.
+
+Endpoints pickle by shm name, so channels pass through task args to
+actors on the same node (host-local, like the reference's shm channels;
+cross-node channels go through the object store instead).
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+import time
+from multiprocessing import shared_memory
+from typing import Any, Optional
+
+_U64 = struct.Struct("<Q")
+
+
+class ChannelTimeoutError(TimeoutError):
+    pass
+
+
+class _Endpoint:
+    def __init__(self, name: str, capacity: int, num_readers: int,
+                 create: bool):
+        self.name = name
+        self.capacity = capacity
+        self.num_readers = num_readers
+        self._hdr = 16 + 8 * num_readers
+        if create:
+            self._shm = shared_memory.SharedMemory(
+                name=name, create=True, size=self._hdr + capacity)
+            self._shm.buf[: self._hdr] = b"\x00" * self._hdr
+        else:
+            self._shm = shared_memory.SharedMemory(name=name)
+        self._owner = create
+
+    # -- header accessors ----------------------------------------------
+    def _get(self, off: int) -> int:
+        return _U64.unpack_from(self._shm.buf, off)[0]
+
+    def _put(self, off: int, v: int) -> None:
+        _U64.pack_into(self._shm.buf, off, v)
+
+    @property
+    def _seq(self) -> int:
+        return self._get(0)
+
+    def close(self) -> None:
+        try:
+            self._shm.close()
+            if self._owner:
+                self._shm.unlink()
+        except Exception:  # noqa: BLE001
+            pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class ChannelReader(_Endpoint):
+    """One reader endpoint (index < num_readers)."""
+
+    def __init__(self, name: str, capacity: int, num_readers: int,
+                 reader_index: int, _create: bool = False):
+        super().__init__(name, capacity, num_readers, create=_create)
+        self.reader_index = reader_index
+        self._last = self._get(16 + 8 * reader_index)
+
+    def read(self, timeout: Optional[float] = 10.0) -> Any:
+        """Block until the NEXT value is written; acknowledge it."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            seq = self._seq
+            if seq > self._last:
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                raise ChannelTimeoutError(
+                    f"no write within {timeout}s (seq={seq})")
+            time.sleep(0.0001)
+        n = self._get(8)
+        value = pickle.loads(bytes(self._shm.buf[self._hdr: self._hdr + n]))
+        self._last = seq
+        self._put(16 + 8 * self.reader_index, seq)  # release
+        return value
+
+    def __reduce__(self):
+        return (ChannelReader, (self.name, self.capacity, self.num_readers,
+                                self.reader_index))
+
+
+class Channel(_Endpoint):
+    """Writer endpoint; create once, ``write()`` per iteration.
+
+    num_readers readers must each ``read()`` every value before the next
+    write proceeds (the reference's acquire/release backpressure).
+    """
+
+    def __init__(self, capacity: int = 1 << 20, num_readers: int = 1,
+                 name: Optional[str] = None, _attach: bool = False):
+        import uuid
+
+        name = name or f"rtch_{uuid.uuid4().hex[:12]}"
+        super().__init__(name, capacity, num_readers,
+                         create=not _attach)
+
+    def write(self, value: Any, timeout: Optional[float] = 10.0) -> None:
+        data = pickle.dumps(value, protocol=5)
+        if len(data) > self.capacity:
+            raise ValueError(
+                f"value of {len(data)}B exceeds channel capacity "
+                f"{self.capacity}B")
+        seq = self._seq
+        deadline = None if timeout is None else time.monotonic() + timeout
+        # wait until every reader consumed the previous value
+        while any(self._get(16 + 8 * i) < seq
+                  for i in range(self.num_readers)):
+            if deadline is not None and time.monotonic() > deadline:
+                raise ChannelTimeoutError(
+                    f"readers did not consume value {seq} within {timeout}s")
+            time.sleep(0.0001)
+        self._shm.buf[self._hdr: self._hdr + len(data)] = data
+        self._put(8, len(data))
+        self._put(0, seq + 1)  # release store LAST
+
+    def reader(self, reader_index: int = 0) -> ChannelReader:
+        if not 0 <= reader_index < self.num_readers:
+            raise ValueError(
+                f"reader_index {reader_index} out of range "
+                f"(num_readers={self.num_readers})")
+        return ChannelReader(self.name, self.capacity, self.num_readers,
+                             reader_index)
+
+    def __reduce__(self):
+        # an unpickled writer endpoint attaches (does not re-create/own)
+        return (Channel, (self.capacity, self.num_readers, self.name, True))

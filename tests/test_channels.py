@@ -1,0 +1,139 @@
+"""Mutable-object channel tests (reference:
+python/ray/tests/test_channel.py over shared_memory_channel.py):
+in-place rewrite semantics, acquire/release backpressure, multi-reader
+fan-out, cross-process transfer through actors."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.experimental import Channel, ChannelTimeoutError
+
+
+class TestLocal:
+    def test_write_read_roundtrip(self):
+        ch = Channel(capacity=1 << 16)
+        r = ch.reader()
+        ch.write({"a": 1, "b": [2, 3]})
+        assert r.read() == {"a": 1, "b": [2, 3]}
+        ch.close()
+
+    def test_in_place_rewrite_many_values(self):
+        ch = Channel(capacity=1 << 16)
+        r = ch.reader()
+        got = []
+
+        def consume():
+            for _ in range(100):
+                got.append(r.read(timeout=30))
+
+        t = threading.Thread(target=consume)
+        t.start()
+        for i in range(100):
+            ch.write(i, timeout=30)
+        t.join(timeout=30)
+        assert got == list(range(100))
+        ch.close()
+
+    def test_backpressure_blocks_writer(self):
+        ch = Channel(capacity=1 << 16)
+        ch.reader()  # never reads
+        ch.write("first")  # slot empty: ok
+        with pytest.raises(ChannelTimeoutError):
+            ch.write("second", timeout=0.3)
+        ch.close()
+
+    def test_reader_timeout(self):
+        ch = Channel(capacity=1 << 16)
+        r = ch.reader()
+        with pytest.raises(ChannelTimeoutError):
+            r.read(timeout=0.3)
+        ch.close()
+
+    def test_capacity_enforced(self):
+        ch = Channel(capacity=64)
+        with pytest.raises(ValueError, match="capacity"):
+            ch.write(np.zeros(1024))
+        ch.close()
+
+    def test_two_readers_each_get_every_value(self):
+        ch = Channel(capacity=1 << 16, num_readers=2)
+        r0, r1 = ch.reader(0), ch.reader(1)
+        got0, got1 = [], []
+
+        def consume(r, out):
+            for _ in range(20):
+                out.append(r.read(timeout=30))
+
+        t0 = threading.Thread(target=consume, args=(r0, got0))
+        t1 = threading.Thread(target=consume, args=(r1, got1))
+        t0.start(); t1.start()
+        for i in range(20):
+            ch.write(i, timeout=30)
+        t0.join(timeout=30); t1.join(timeout=30)
+        assert got0 == got1 == list(range(20))
+        ch.close()
+
+
+class TestCrossProcess:
+    def test_driver_to_actor_stream(self, ray_start_regular):
+        ch = Channel(capacity=1 << 16)
+
+        @ray_tpu.remote
+        class Consumer:
+            def __init__(self, reader):
+                self.reader = reader
+                self.total = 0
+
+            def consume(self, n):
+                for _ in range(n):
+                    self.total += self.reader.read(timeout=60)
+                return self.total
+
+        c = Consumer.remote(ch.reader())
+        fut = c.consume.remote(10)
+        for i in range(10):
+            ch.write(i, timeout=60)
+        assert ray_tpu.get(fut, timeout=120) == sum(range(10))
+        ray_tpu.kill(c)
+        ch.close()
+
+    def test_actor_to_actor_pipeline(self, ray_start_regular):
+        """The compiled-DAG shape: stage A writes into a channel, stage
+        B reads — repeated transfers with no object store traffic."""
+        ch = Channel(capacity=1 << 20)
+
+        @ray_tpu.remote
+        class Producer:
+            def __init__(self, channel):
+                self.ch = channel
+
+            def produce(self, n):
+                import numpy as _np
+
+                for i in range(n):
+                    self.ch.write(_np.full(128, i), timeout=60)
+                return n
+
+        @ray_tpu.remote
+        class Consumer:
+            def __init__(self, reader):
+                self.reader = reader
+
+            def consume(self, n):
+                s = 0.0
+                for _ in range(n):
+                    s += float(self.reader.read(timeout=60).sum())
+                return s
+
+        p = Producer.remote(ch)
+        c = Consumer.remote(ch.reader())
+        fut = c.consume.remote(8)
+        ray_tpu.get(p.produce.remote(8), timeout=120)
+        assert ray_tpu.get(fut, timeout=120) == sum(i * 128 for i in range(8))
+        ray_tpu.kill(p)
+        ray_tpu.kill(c)
+        ch.close()
