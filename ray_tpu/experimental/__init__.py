@@ -1,6 +1,18 @@
 """ray_tpu.experimental — channels (mutable shared-memory objects) and
 other pre-stable APIs (reference: python/ray/experimental/)."""
 
-from ray_tpu.experimental.channel import Channel, ChannelReader, ChannelTimeoutError
+from ray_tpu.experimental.channel import (
+    Channel,
+    ChannelReader,
+    ChannelTimeoutError,
+    TensorChannel,
+    TensorChannelReader,
+)
 
-__all__ = ["Channel", "ChannelReader", "ChannelTimeoutError"]
+__all__ = [
+    "Channel",
+    "ChannelReader",
+    "ChannelTimeoutError",
+    "TensorChannel",
+    "TensorChannelReader",
+]

@@ -76,6 +76,12 @@ class _Endpoint:
             pass
 
 
+def _tensor_nbytes(shape, dtype) -> int:
+    import numpy as np
+
+    return int(np.dtype(dtype).itemsize * max(1, int(np.prod(tuple(shape)))))
+
+
 class ChannelReader(_Endpoint):
     """One reader endpoint (index < num_readers)."""
 
@@ -85,17 +91,21 @@ class ChannelReader(_Endpoint):
         self.reader_index = reader_index
         self._last = self._get(16 + 8 * reader_index)
 
-    def read(self, timeout: Optional[float] = 10.0) -> Any:
-        """Block until the NEXT value is written; acknowledge it."""
+    def _await_next(self, timeout: Optional[float]) -> int:
+        """Spin until a sequence newer than the last-read one exists."""
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             seq = self._seq
             if seq > self._last:
-                break
+                return seq
             if deadline is not None and time.monotonic() > deadline:
                 raise ChannelTimeoutError(
                     f"no write within {timeout}s (seq={seq})")
             time.sleep(0.0001)
+
+    def read(self, timeout: Optional[float] = 10.0) -> Any:
+        """Block until the NEXT value is written; acknowledge it."""
+        seq = self._await_next(timeout)
         n = self._get(8)
         value = pickle.loads(bytes(self._shm.buf[self._hdr: self._hdr + n]))
         self._last = seq
@@ -122,6 +132,16 @@ class Channel(_Endpoint):
         super().__init__(name, capacity, num_readers,
                          create=not _attach)
 
+    def _await_acks(self, seq: int, timeout: Optional[float]) -> None:
+        """Spin until every reader consumed the previous value."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while any(self._get(16 + 8 * i) < seq
+                  for i in range(self.num_readers)):
+            if deadline is not None and time.monotonic() > deadline:
+                raise ChannelTimeoutError(
+                    f"readers did not consume value {seq} within {timeout}s")
+            time.sleep(0.0001)
+
     def write(self, value: Any, timeout: Optional[float] = 10.0) -> None:
         data = pickle.dumps(value, protocol=5)
         if len(data) > self.capacity:
@@ -129,14 +149,7 @@ class Channel(_Endpoint):
                 f"value of {len(data)}B exceeds channel capacity "
                 f"{self.capacity}B")
         seq = self._seq
-        deadline = None if timeout is None else time.monotonic() + timeout
-        # wait until every reader consumed the previous value
-        while any(self._get(16 + 8 * i) < seq
-                  for i in range(self.num_readers)):
-            if deadline is not None and time.monotonic() > deadline:
-                raise ChannelTimeoutError(
-                    f"readers did not consume value {seq} within {timeout}s")
-            time.sleep(0.0001)
+        self._await_acks(seq, timeout)
         self._shm.buf[self._hdr: self._hdr + len(data)] = data
         self._put(8, len(data))
         self._put(0, seq + 1)  # release store LAST
@@ -152,3 +165,79 @@ class Channel(_Endpoint):
     def __reduce__(self):
         # an unpickled writer endpoint attaches (does not re-create/own)
         return (Channel, (self.capacity, self.num_readers, self.name, True))
+
+
+# ---------------------------------------------------------------------------
+# Typed tensor channels — the RDT host path (reference:
+# python/ray/experimental/rdt/ — tensor transports bypassing the object
+# store). Fixed shape+dtype means the payload is written as raw array
+# bytes straight into shared memory: no pickling, no allocation per
+# transfer. The device path needs no transport at all on TPU — arrays
+# move with jax.device_put / inside jitted collectives over ICI.
+# ---------------------------------------------------------------------------
+class TensorChannelReader(ChannelReader):
+    def __init__(self, name: str, shape, dtype: str, num_readers: int,
+                 reader_index: int):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        super().__init__(name, _tensor_nbytes(shape, dtype), num_readers,
+                         reader_index)
+
+    def read(self, timeout: Optional[float] = 10.0):
+        """Returns a fresh ndarray (copied out of the slot — the writer
+        reuses it immediately after the ack)."""
+        import numpy as np
+
+        seq = self._await_next(timeout)
+        view = np.ndarray(self.shape, self.dtype,
+                          buffer=self._shm.buf, offset=self._hdr)
+        out = view.copy()
+        self._last = seq
+        self._put(16 + 8 * self.reader_index, seq)
+        return out
+
+    def __reduce__(self):
+        return (TensorChannelReader, (self.name, self.shape, self.dtype,
+                                      self.num_readers, self.reader_index))
+
+
+class TensorChannel(Channel):
+    """Zero-copy fixed-shape tensor slot: ``write`` copies array bytes
+    directly into shared memory (no pickle)."""
+
+    def __init__(self, shape, dtype: str = "float32", num_readers: int = 1,
+                 name: Optional[str] = None, _attach: bool = False):
+        import numpy as np
+
+        self.shape = tuple(shape)
+        self.dtype = str(np.dtype(dtype))
+        super().__init__(_tensor_nbytes(shape, dtype), num_readers, name,
+                         _attach)
+
+    def write(self, arr, timeout: Optional[float] = 10.0) -> None:
+        import numpy as np
+
+        arr = np.ascontiguousarray(arr)
+        if arr.shape != self.shape or str(arr.dtype) != self.dtype:
+            raise ValueError(
+                f"expected {self.shape} {self.dtype}, got "
+                f"{arr.shape} {arr.dtype}")
+        seq = self._seq
+        self._await_acks(seq, timeout)
+        dest = np.ndarray(self.shape, self.dtype,
+                          buffer=self._shm.buf, offset=self._hdr)
+        dest[...] = arr
+        self._put(8, arr.nbytes)
+        self._put(0, seq + 1)
+
+    def reader(self, reader_index: int = 0) -> TensorChannelReader:
+        if not 0 <= reader_index < self.num_readers:
+            raise ValueError(
+                f"reader_index {reader_index} out of range "
+                f"(num_readers={self.num_readers})")
+        return TensorChannelReader(self.name, self.shape, self.dtype,
+                                   self.num_readers, reader_index)
+
+    def __reduce__(self):
+        return (TensorChannel, (self.shape, self.dtype, self.num_readers,
+                                self.name, True))
