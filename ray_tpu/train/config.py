@@ -29,6 +29,15 @@ class ScalingConfig:
     resources_per_worker: Optional[Dict[str, float]] = None
     mesh: MeshSpec = dataclasses.field(default_factory=lambda: MeshSpec(data=-1))
     num_slices: int = 1  # >1 = multi-slice (MEGASCALE over DCN)
+    # elastic scaling (reference: scaling_policy/elastic.py:29): when set,
+    # each (re)start sizes the group to what the cluster can actually
+    # host, between min_workers and num_workers — a lost node shrinks the
+    # group instead of stalling the restart loop.
+    min_workers: Optional[int] = None
+
+    @property
+    def elastic(self) -> bool:
+        return self.min_workers is not None
 
     def worker_resources(self) -> Dict[str, float]:
         res = dict(self.resources_per_worker or {})

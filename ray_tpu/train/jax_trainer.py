@@ -156,7 +156,12 @@ class JaxTrainer:
 
     def _run_attempt(self, name: str, storage: str,
                      latest: Optional[Checkpoint]) -> List[Dict[str, Any]]:
-        n = self._scaling.num_workers
+        from ray_tpu.train.scaling_policy import decide_num_workers
+
+        # elastic: size this (re)start to what the cluster can host now
+        # (reference: ElasticScalingPolicy elastic.py:29) — a lost node
+        # shrinks the group, restarting from the latest checkpoint
+        n = decide_num_workers(self._scaling)
         latest_path = latest.path if latest else None
         if n <= 1:
             # In-process fast path (reference: local mode,

@@ -265,3 +265,35 @@ class TestJaxTrainer:
         ).fit()
         assert res.error is None
         assert res.metrics["world"] == 2 and res.metrics["rank"] == 0
+
+    def test_elastic_scaling_sizes_to_cluster(self, ray_start_regular,
+                                              tmp_path):
+        """min_workers set → the group shrinks to what the cluster can
+        host (reference: ElasticScalingPolicy elastic.py:29). The fixture
+        cluster has 4 CPUs; asking for 8 workers x 1 CPU elastically
+        lands on fewer (>= min) instead of stalling."""
+        import ray_tpu.train as train
+
+        def loop(config):
+            ctx = train.get_context()
+            train.report({"world": ctx.get_world_size()})
+
+        res = train.JaxTrainer(
+            loop,
+            train_loop_config={},
+            scaling_config=train.ScalingConfig(num_workers=8, min_workers=1),
+            run_config=train.RunConfig(name="t_elastic",
+                                       storage_path=str(tmp_path)),
+        ).fit()
+        assert res.error is None
+        assert 1 <= res.metrics["world"] <= 4  # sized to the 4-CPU cluster
+
+    def test_elastic_decision_function(self):
+        from ray_tpu.train.config import ScalingConfig
+        from ray_tpu.train.scaling_policy import decide_num_workers
+
+        fixed = ScalingConfig(num_workers=5)
+        assert not fixed.elastic
+        assert decide_num_workers(fixed) == 5
+        el = ScalingConfig(num_workers=5, min_workers=2)
+        assert el.elastic
