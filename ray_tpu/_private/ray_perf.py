@@ -1,0 +1,161 @@
+"""Microbenchmark suite (reference: python/ray/_private/ray_perf.py:95-290
+and release/microbenchmark/run_microbenchmark.py).
+
+Measures the core-runtime hot paths in ops/s: plasma put/get, task
+submission, sync/async actor calls, channels. Run directly:
+
+    python -m ray_tpu._private.ray_perf [--small]
+
+Prints one line per benchmark plus a JSON summary.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Dict, List
+
+import numpy as np
+
+
+def timeit(name: str, fn: Callable, multiplier: int = 1,
+           duration_s: float = 2.0, warmup: int = 3) -> Dict:
+    for _ in range(warmup):
+        fn()
+    count = 0
+    start = time.perf_counter()
+    while time.perf_counter() - start < duration_s:
+        fn()
+        count += 1
+    dt = time.perf_counter() - start
+    rate = count * multiplier / dt
+    print(f"{name:<44s} {rate:>12,.1f} ops/s")
+    return {"name": name, "ops_per_s": rate}
+
+
+def main(small: bool = False) -> List[Dict]:
+    import ray_tpu
+
+    init_info = ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    owns_runtime = not init_info.get("already_initialized")
+    results: List[Dict] = []
+    dur = 0.5 if small else 2.0
+
+    # -- object store ---------------------------------------------------
+    arr_small = np.zeros(100, np.float32)
+    arr_1mb = np.zeros((512, 512), np.float32)
+
+    def put_small():
+        ray_tpu.put(arr_small)
+
+    results.append(timeit("single client put (400B)", put_small,
+                          duration_s=dur))
+
+    def put_1mb():
+        ray_tpu.put(arr_1mb)
+
+    results.append(timeit("single client put (1MB)", put_1mb,
+                          duration_s=dur))
+
+    ref_small = ray_tpu.put(arr_small)
+    ref_1mb = ray_tpu.put(arr_1mb)
+
+    def get_small():
+        ray_tpu.get(ref_small)
+
+    results.append(timeit("single client get (400B)", get_small,
+                          duration_s=dur))
+
+    def get_1mb():
+        ray_tpu.get(ref_1mb)
+
+    results.append(timeit("single client get (1MB)", get_1mb,
+                          duration_s=dur))
+
+    # -- tasks ----------------------------------------------------------
+    @ray_tpu.remote
+    def tiny(x):
+        return x
+
+    def tasks_sync():
+        ray_tpu.get(tiny.remote(0))
+
+    results.append(timeit("tasks sync (roundtrip)", tasks_sync,
+                          duration_s=dur))
+
+    batch = 100 if small else 1000
+
+    def tasks_batch():
+        ray_tpu.get([tiny.remote(i) for i in range(batch)])
+
+    results.append(timeit(f"tasks async batch ({batch})", tasks_batch,
+                          multiplier=batch, duration_s=dur))
+
+    # -- actors ---------------------------------------------------------
+    @ray_tpu.remote
+    class Actor:
+        def m(self, x):
+            return x
+
+    a = Actor.remote()
+    ray_tpu.get(a.m.remote(0))
+
+    def actor_sync():
+        ray_tpu.get(a.m.remote(0))
+
+    results.append(timeit("1:1 actor calls sync", actor_sync,
+                          duration_s=dur))
+
+    def actor_async():
+        ray_tpu.get([a.m.remote(i) for i in range(batch)])
+
+    results.append(timeit(f"1:1 actor calls async ({batch})", actor_async,
+                          multiplier=batch, duration_s=dur))
+
+    b = Actor.options(max_concurrency=8).remote()
+    ray_tpu.get(b.m.remote(0))
+
+    def actor_conc():
+        ray_tpu.get([b.m.remote(i) for i in range(batch)])
+
+    results.append(timeit(f"1:1 async-actor calls ({batch})", actor_conc,
+                          multiplier=batch, duration_s=dur))
+
+    # -- channels (compiled-DAG transport) -------------------------------
+    from ray_tpu.experimental import Channel, TensorChannel
+
+    ch = Channel(capacity=1 << 16)
+    rd = ch.reader()
+
+    def chan_rt():
+        ch.write(0)
+        rd.read()
+
+    results.append(timeit("channel write+read (pickle)", chan_rt,
+                          duration_s=dur))
+    ch.close()
+
+    tch = TensorChannel((512, 512), "float32")
+    trd = tch.reader()
+
+    def tchan_rt():
+        tch.write(arr_1mb)
+        trd.read()
+
+    results.append(timeit("tensor channel write+read (1MB)", tchan_rt,
+                          duration_s=dur))
+    tch.close()
+
+    ray_tpu.kill(a)
+    ray_tpu.kill(b)
+    print(json.dumps({r["name"]: round(r["ops_per_s"], 1)
+                      for r in results}))
+    if owns_runtime:  # never tear down a caller's cluster
+        ray_tpu.shutdown()
+    return results
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(small="--small" in sys.argv)

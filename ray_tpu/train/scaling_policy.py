@@ -59,13 +59,26 @@ def decide_num_workers(scaling: ScalingConfig) -> int:
             "elastic sizing: cluster resource query failed; keeping "
             "num_workers=%d", hi)
         return hi
-    n = max(lo, min(hi, hostable))
-    if scaling.use_tpu and scaling.topology and scaling.num_slices >= 1:
+    if scaling.use_tpu and scaling.topology:
         # TPU slices are all-or-nothing ICI domains: a partial slice
         # cannot form the mesh, so elastic resize moves in whole-slice
-        # units (SURVEY.md §7 'slice-granular failure domains')
+        # units (SURVEY.md §7 'slice-granular failure domains') — and
+        # min_workers rounds UP to a slice multiple so the [lo, hi]
+        # contract holds after rounding
         slice_hosts = max(1, scaling.num_workers // max(1, scaling.num_slices))
+        lo = ((lo + slice_hosts - 1) // slice_hosts) * slice_hosts
+        n = max(lo, min(hi, hostable))
         n = max(slice_hosts, (n // slice_hosts) * slice_hosts)
+        if n > hostable:
+            # single-slice (or too few whole slices hostable): TPU slices
+            # can't shrink below one slice, so this attempt WAITS for
+            # capacity (e.g. the autoscaler replacing the slice) — say so
+            logger.warning(
+                "elastic sizing: cluster hosts %d workers but a whole "
+                "slice needs %d — the attempt will wait for capacity",
+                hostable, n)
+    else:
+        n = max(lo, min(hi, hostable))
     if n != hi:
         logger.info("elastic sizing: %d/%d workers hostable", n, hi)
     return n

@@ -132,3 +132,13 @@ def test_tpu_profile_context(cluster, tmp_path):
     with ray_tpu.tpu_profile(logdir):
         (jnp.ones((64, 64)) @ jnp.ones((64, 64))).block_until_ready()
     assert glob.glob(logdir + "/**/*", recursive=True)
+
+
+def test_microbenchmark_suite_runs():
+    """The ray_perf microbenchmark suite (reference: _private/ray_perf.py)
+    produces a positive rate for every benchmark."""
+    from ray_tpu._private.ray_perf import main as perf_main
+
+    results = perf_main(small=True)
+    assert len(results) >= 10
+    assert all(r["ops_per_s"] > 0 for r in results)
