@@ -112,6 +112,13 @@ def init(
             from ray_tpu._private.local_mode import LocalModeRuntime
 
             w.core = LocalModeRuntime(resources=resources, num_cpus=num_cpus or 8)
+        elif address and str(address).startswith("ray://"):
+            # remote driver over TCP (reference: ray client, util/client/):
+            # the whole CoreRuntime proxies to a head-side ClientServer
+            from ray_tpu.util.client import ClientRuntime
+
+            w.core = ClientRuntime(str(address)[len("ray://"):])
+            w.core.job_runtime_env = runtime_env or {}
         else:
             from ray_tpu._private.cluster_runtime import ClusterRuntime
 

@@ -75,6 +75,23 @@ def cmd_start(args) -> int:
             state["dashboard_pid"] = dash.pid
             state["dashboard_address"] = f"http://127.0.0.1:{dash_port}"
             print(f"  dashboard: http://127.0.0.1:{dash_port}")
+        client_port = getattr(args, "client_server_port", 10001)
+        if client_port:
+            repo_root = os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))
+            env = dict(os.environ)
+            env["PYTHONPATH"] = os.pathsep.join(
+                p for p in [repo_root, env.get("PYTHONPATH", "")] if p)
+            csrv = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.util.client.server",
+                 "--gcs", addr, "--port", str(client_port)],
+                env=env,
+                stdout=open(os.path.join(node.session_dir,
+                                         "client_server.log"), "ab"),
+                stderr=subprocess.STDOUT,
+            )
+            state["client_server_pid"] = csrv.pid
+            print(f"  remote drivers: ray_tpu.init(address='ray://<host>:{client_port}')")
         _write_state(state)
         print(f"ray_tpu head started.\n  address: {addr}")
         print(f"  connect with: ray_tpu.init(address='{addr}')")
@@ -131,7 +148,8 @@ def cmd_stop(_args) -> int:
     n = 0
     if state:
         for pid in state.get("raylet_pids", []) + [
-                state.get("gcs_pid"), state.get("dashboard_pid")]:
+                state.get("gcs_pid"), state.get("dashboard_pid"),
+                state.get("client_server_pid")]:
             if pid:
                 try:
                     os.kill(pid, signal.SIGTERM)
@@ -212,6 +230,8 @@ def main(argv=None) -> int:
                     help="0 disables the dashboard")
     sp.add_argument("--labels", default=None,
                     help="JSON node labels (worker join; autoscaler key)")
+    sp.add_argument("--client-server-port", type=int, default=10001,
+                    help="ray:// remote-driver port (0 disables)")
     sp.set_defaults(fn=cmd_start)
 
     sp = sub.add_parser("stop", help="stop processes started by this CLI")

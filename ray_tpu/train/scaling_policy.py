@@ -67,6 +67,9 @@ def decide_num_workers(scaling: ScalingConfig) -> int:
         # contract holds after rounding
         slice_hosts = max(1, scaling.num_workers // max(1, scaling.num_slices))
         lo = ((lo + slice_hosts - 1) // slice_hosts) * slice_hosts
+        # never exceed the configured max: if rounding pushed the floor
+        # past it, fall back to the largest slice multiple within hi
+        lo = min(lo, max(slice_hosts, (hi // slice_hosts) * slice_hosts))
         n = max(lo, min(hi, hostable))
         n = max(slice_hosts, (n // slice_hosts) * slice_hosts)
         if n > hostable:

@@ -1,0 +1,17 @@
+"""ray_tpu.util.client — remote drivers over TCP (reference:
+python/ray/util/client, `ray://` connections).
+
+A normal driver shares the head node's unix-socket object store, so it
+must run ON a cluster machine. Client mode lifts that: the driver's
+entire CoreRuntime is an RPC proxy to a ClientServer process running on
+the head, which owns the real objects/actors on the client's behalf.
+
+    ray_tpu.init(address="ray://head:10001")   # full API, remote machine
+
+Start the server with the head (`ray-tpu start --head` does it) or
+manually: ``python -m ray_tpu.util.client.server --gcs host:port``.
+"""
+
+from ray_tpu.util.client.client import ClientRuntime
+
+__all__ = ["ClientRuntime"]

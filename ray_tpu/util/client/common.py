@@ -1,0 +1,35 @@
+"""Shared client/server marshalling: ObjectRefs cross the wire as
+markers that the server resolves against its per-client ref registry
+at unpickle time (so refs nested anywhere inside args work)."""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, Dict
+
+# server-side: set per-request to the active client's ref registry
+_resolver_registry: Dict[str, Any] = {}
+
+
+def _resolve_marker(ref_hex: str):
+    ref = _resolver_registry.get(ref_hex)
+    if ref is None:
+        raise KeyError(f"client ref {ref_hex} is not registered on the "
+                       f"server (already released?)")
+    return ref
+
+
+class _ClientPickler(pickle.Pickler):
+    def reducer_override(self, obj):
+        from ray_tpu._private.object_ref import ObjectRef
+
+        if isinstance(obj, ObjectRef):
+            return (_resolve_marker, (obj.hex(),))
+        return NotImplemented
+
+
+def dumps_with_refs(value: Any) -> bytes:
+    buf = io.BytesIO()
+    _ClientPickler(buf, protocol=5).dump(value)
+    return buf.getvalue()
