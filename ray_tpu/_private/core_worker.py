@@ -1318,8 +1318,15 @@ class CoreWorker(CoreRuntime):
         self._node_view_cache = (now, alive)
         return alive
 
-    async def _lease_target(self, strategy) -> Tuple[Tuple[str, int], bool]:
-        """(raylet addr to lease from, allow_spillback) per strategy."""
+    async def _lease_target(
+        self, strategy, resources: Dict[str, float],
+    ) -> Tuple[Tuple[str, int], bool, str]:
+        """(raylet addr to lease from, allow_spillback, hard_kind) per
+        strategy. hard_kind is "" (no hard constraint), "pinned" (hard
+        NodeAffinity — infeasible at that node means infeasible, full
+        stop) or "labeled" (hard NodeLabel — another matching or future
+        autoscaled node may still fit, so the raylet queues rather than
+        fails when autoscaling is on)."""
         import random as _random
 
         kind = strategy.kind
@@ -1328,12 +1335,13 @@ class CoreWorker(CoreRuntime):
                 for n in await self._node_view(force=force):
                     if n["NodeID"] == strategy.node_id:
                         return ((n["NodeManagerAddress"],
-                                 n["NodeManagerPort"]), bool(strategy.soft))
+                                 n["NodeManagerPort"]), bool(strategy.soft),
+                                "" if strategy.soft else "pinned")
                 # the cache can be up to 2s stale — a just-registered
                 # node must not read as dead for a HARD constraint, so
                 # re-check against a fresh view before failing
             if strategy.soft:
-                return self.raylet_addr, True
+                return self.raylet_addr, True, ""
             raise _InfeasibleStrategyError(
                 f"node {strategy.node_id!r} is not alive "
                 f"(NodeAffinity soft=False)")
@@ -1341,12 +1349,12 @@ class CoreWorker(CoreRuntime):
             try:
                 nodes = await self._node_view()
             except _TransientSchedulingError:
-                return self.raylet_addr, True  # preference, not constraint
+                return self.raylet_addr, True, ""  # preference, not constraint
             if nodes:
                 self._spread_rr += 1
                 n = nodes[self._spread_rr % len(nodes)]
                 return ((n["NodeManagerAddress"],
-                         n["NodeManagerPort"]), True)
+                         n["NodeManagerPort"]), True, "")
         if kind == "NODE_LABEL":
             hard = strategy.node_labels or {}
 
@@ -1355,24 +1363,41 @@ class CoreWorker(CoreRuntime):
                         if all(n.get("Labels", {}).get(k) == v
                                for k, v in hard.items())]
 
+            def _fitting(nodes):
+                # among matching nodes, only those whose TOTALS fit the
+                # request can ever serve it — picking an undersized match
+                # would read as infeasible at that node even though a
+                # bigger match exists
+                return [m for m in nodes
+                        if all(m.get("Resources", {}).get(k, 0.0) >= v
+                               for k, v in resources.items())]
+
             matches = _matching(await self._node_view())
-            if not matches:  # stale-cache re-check before hard failure
+            if not matches or not _fitting(matches):
+                # stale-cache re-check before committing to failure or an
+                # undersized match: a just-registered fitting node must
+                # not be missed for a HARD constraint
                 matches = _matching(await self._node_view(force=True))
             if matches:
+                pool = _fitting(matches) or matches
                 # prefer nodes with spare CPU, pick randomly among them
                 # (a deterministic 'best' pick herds every concurrent
                 # submitter onto one matching node for the cache window)
-                free = [m for m in matches if m.get(
+                free = [m for m in pool if m.get(
                     "AvailableResources", {}).get("CPU", 0.0) > 0]
-                n = _random.choice(free or matches)
+                n = _random.choice(free or pool)
+                # soft label preference: matching node first, but any
+                # node is legal — spillback allowed, no hard constraint
                 return ((n["NodeManagerAddress"],
-                         n["NodeManagerPort"]), False)
+                         n["NodeManagerPort"]),
+                        bool(strategy.soft),
+                        "" if strategy.soft else "labeled")
             if strategy.soft:
-                return self.raylet_addr, True
+                return self.raylet_addr, True, ""
             raise _InfeasibleStrategyError(
                 f"no alive node matches labels {hard!r} "
                 f"(NodeLabel soft=False)")
-        return self.raylet_addr, True
+        return self.raylet_addr, True, ""
 
     async def _maybe_request_lease(self, sc, spec: TaskSpec) -> None:
         with self._lock:
@@ -1394,7 +1419,8 @@ class CoreWorker(CoreRuntime):
                 runtime_env_hash=spec.runtime_env_hash(),
             )
             try:
-                target_addr, allow_spill = await self._lease_target(strategy)
+                target_addr, allow_spill, hard_kind = \
+                    await self._lease_target(strategy, spec.resources)
             except _InfeasibleStrategyError as e:
                 err = RayTaskError(
                     spec.function_descriptor.repr_name, str(e))
@@ -1406,6 +1432,10 @@ class CoreWorker(CoreRuntime):
                 # be perfectly satisfiable
                 raise RuntimeError(f"node view unavailable: {e}") from None
             kwargs["allow_spillback"] = allow_spill
+            # "pinned"/"labeled" tells the raylet it must run the lease
+            # locally or fail/queue precisely, never redirect it to a
+            # node that may violate the constraint
+            kwargs["hard_node_constraint"] = hard_kind
             client = self.raylet if tuple(target_addr) == tuple(
                 self.raylet_addr) else get_client(tuple(target_addr))
             granted_by: Tuple[str, int] = tuple(target_addr)

@@ -295,6 +295,7 @@ class Raylet:
         lease_timeout: float = 25.0,
         release_cpu_after_grant: bool = False,
         allow_spillback: bool = True,
+        hard_node_constraint: str = "",
         runtime_env_hash: str = "",
     ) -> dict:
         req = {
@@ -306,6 +307,13 @@ class Raylet:
             "bundle_index": bundle_index,
             "release_cpu_after_grant": release_cpu_after_grant,
             "runtime_env_hash": runtime_env_hash,
+            # "pinned" (hard NodeAffinity) / "labeled" (hard NodeLabel):
+            # the lease must run HERE — distinct from allow_spillback=False
+            # alone, which also marks already-spilled requests (loop
+            # prevention) that may still be redirected. A pinned lease that
+            # can't fit is infeasible outright; a labeled one may be served
+            # by another matching or autoscaled node after caller retry.
+            "hard_node_constraint": hard_node_constraint,
         }
         logger.debug(
             "lease request %s avail=%s idle=%d workers=%d",
@@ -334,6 +342,15 @@ class Raylet:
             if target is not None:
                 return {"granted": False, "spillback": target}
         if not rs.feasible(self._cpu_only(req["resources"], pg_id)):
+            if hard_node_constraint == "pinned":
+                # pinned to THIS node and can never fit here: no spillback,
+                # and no autoscaled node can ever serve it — fail now
+                return self._infeasible_reply(req["resources"], rs)
+            if hard_node_constraint == "labeled" and \
+                    not self.autoscaling_enabled:
+                # the caller already picked the best label match; with no
+                # autoscaler a bigger matching node will never appear
+                return self._infeasible_reply(req["resources"], rs)
             if allow_spillback and not pg_id:
                 # The cluster view may be a couple of heartbeats behind (a
                 # just-joined node propagates via its heartbeat to GCS, then
@@ -345,12 +362,7 @@ class Raylet:
                 if target is not None:
                     return {"granted": False, "spillback": target}
             if not self.autoscaling_enabled:
-                return {
-                    "granted": False,
-                    "infeasible": True,
-                    "error": f"resources {resources} can never be satisfied on this node "
-                    f"(total: {rs.total})",
-                }
+                return self._infeasible_reply(resources, rs)
             # An attached autoscaler may add a node that fits: queue the
             # request so its shape shows up as demand in heartbeats
             # (reference: infeasible tasks wait for the autoscaler); the
@@ -370,6 +382,15 @@ class Raylet:
 
     def _cpu_only(self, resources: Dict[str, float], pg_id: Optional[str]) -> Dict[str, float]:
         return dict(resources)
+
+    @staticmethod
+    def _infeasible_reply(resources: Dict[str, float], rs) -> dict:
+        return {
+            "granted": False,
+            "infeasible": True,
+            "error": f"resources {resources} can never be satisfied on "
+            f"this node (total: {rs.total})",
+        }
 
     async def _await_spillback(
         self, resources: Dict[str, float], timeout_s: float
@@ -593,6 +614,24 @@ class Raylet:
                 rs, _ = self._resource_set_for(p.request)
                 if not p.request.get("pg_id") and \
                         not rs.feasible(p.request["resources"]):
+                    # a hard node constraint must never be redirected
+                    # elsewhere (spilled requests — allow_spillback=False
+                    # without the constraint — may still be re-redirected):
+                    # pinned fails precisely; labeled stays queued as
+                    # autoscaler demand until the caller's timeout retry
+                    # re-picks among (possibly new) matching nodes
+                    hard = p.request.get("hard_node_constraint")
+                    if hard == "pinned":
+                        if not p.future.done():
+                            try:
+                                p.future.set_result(self._infeasible_reply(
+                                    p.request["resources"], rs))
+                            except asyncio.InvalidStateError:
+                                pass
+                        continue
+                    if hard == "labeled":
+                        still.append(p)
+                        continue
                     target = self._pick_spillback(
                         p.request["resources"], require_available=False)
                     if target is not None and not p.future.done():

@@ -143,6 +143,9 @@ class GCETpuNodeProvider(NodeProvider):
         self.prefix = prefix
         self.setup_command = setup_command
         self._n = 0
+        # name → node_type, recorded at create time: parsing the type back
+        # out of the VM name breaks for dashed type keys / custom prefixes
+        self._name_to_type: Dict[str, str] = {}
 
     def _gcloud(self, *args: str) -> str:
         try:
@@ -175,6 +178,7 @@ class GCETpuNodeProvider(NodeProvider):
     def create_node(self, node_type, node_config, labels):
         self._n += 1
         name = f"{self.prefix}-{node_type}-{self._n}"
+        self._name_to_type[name] = node_type
         acc = node_config["accelerator_type"]
         # --metadata splits on commas (the JSON labels always contain
         # one) — the script must go through --metadata-from-file
@@ -202,6 +206,7 @@ class GCETpuNodeProvider(NodeProvider):
             "compute", "tpus", "tpu-vm", "delete", provider_node_id,
             f"--project={self.project}", f"--zone={self.zone}", "--quiet",
         )
+        self._name_to_type.pop(provider_node_id, None)
 
     def non_terminated_nodes(self):
         out = self._gcloud(
@@ -209,5 +214,13 @@ class GCETpuNodeProvider(NodeProvider):
             f"--project={self.project}", f"--zone={self.zone}",
             "--format=value(name)",
         )
-        return {n: n.split("-")[2] if n.count("-") >= 2 else "tpu"
-                for n in out.split() if n.startswith(self.prefix)}
+        return {n: self._name_to_type.get(n, self._parse_type(n))
+                for n in out.split() if n.startswith(self.prefix + "-")}
+
+    def _parse_type(self, name: str) -> str:
+        # nodes created by an earlier provider incarnation: strip the
+        # "<prefix>-" head and the "-<counter>" tail; what remains is the
+        # type key even when it contains dashes
+        body = name[len(self.prefix) + 1:]
+        head, _, tail = body.rpartition("-")
+        return head if head and tail.isdigit() else body

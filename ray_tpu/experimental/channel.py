@@ -9,11 +9,19 @@ acquire/release by sequence number, so steady-state transfer does no
 allocation, no socket round-trip, and no object-store bookkeeping.
 
 Layout: [seq u64][len u64][ack_0 u64 ... ack_{R-1} u64][payload].
-Write protocol: wait until every reader's ack == seq (previous value
-consumed) → write payload, then len, then seq+1 (seq is the release
-store; x86-TSO plus the GIL make this ordering safe for CPython-level
-stores). Read protocol: wait until seq > last seen → read payload →
-store ack = seq.
+Classic seqlock shape: seq is EVEN when the slot is stable, ODD while
+a write is in progress; each write advances it by 2. Write protocol:
+wait until every reader's ack == seq (previous value consumed) →
+seq+1 (odd) → write len + payload → seq+2 (even). Read protocol: wait
+until an even seq > last seen → copy payload → re-read seq; if it
+moved, the copy may be torn — retry → store ack = seq.
+
+Honesty note on memory ordering: CPython exposes no fences, so the
+re-check narrows but cannot fully close the weak-ordering window (a
+reader could in principle observe the even seq before the payload
+stores on e.g. ARM). On x86-TSO the store order plus the re-check make
+torn reads impossible; full portability would need real atomics in a
+C extension.
 
 Endpoints pickle by shm name, so channels pass through task args to
 actors on the same node (host-local, like the reference's shm channels;
@@ -92,11 +100,12 @@ class ChannelReader(_Endpoint):
         self._last = self._get(16 + 8 * reader_index)
 
     def _await_next(self, timeout: Optional[float]) -> int:
-        """Spin until a sequence newer than the last-read one exists."""
+        """Spin until a stable (even) sequence newer than the last-read
+        one exists."""
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             seq = self._seq
-            if seq > self._last:
+            if seq > self._last and seq % 2 == 0:
                 return seq
             if deadline is not None and time.monotonic() > deadline:
                 raise ChannelTimeoutError(
@@ -105,9 +114,13 @@ class ChannelReader(_Endpoint):
 
     def read(self, timeout: Optional[float] = 10.0) -> Any:
         """Block until the NEXT value is written; acknowledge it."""
-        seq = self._await_next(timeout)
-        n = self._get(8)
-        value = pickle.loads(bytes(self._shm.buf[self._hdr: self._hdr + n]))
+        while True:
+            seq = self._await_next(timeout)
+            n = self._get(8)
+            data = bytes(self._shm.buf[self._hdr: self._hdr + n])
+            if self._seq == seq:  # seqlock re-check: no concurrent write
+                break
+        value = pickle.loads(data)
         self._last = seq
         self._put(16 + 8 * self.reader_index, seq)  # release
         return value
@@ -150,9 +163,10 @@ class Channel(_Endpoint):
                 f"{self.capacity}B")
         seq = self._seq
         self._await_acks(seq, timeout)
+        self._put(0, seq + 1)  # odd: write in progress
         self._shm.buf[self._hdr: self._hdr + len(data)] = data
         self._put(8, len(data))
-        self._put(0, seq + 1)  # release store LAST
+        self._put(0, seq + 2)  # even: release
 
     def reader(self, reader_index: int = 0) -> ChannelReader:
         if not 0 <= reader_index < self.num_readers:
@@ -188,10 +202,13 @@ class TensorChannelReader(ChannelReader):
         reuses it immediately after the ack)."""
         import numpy as np
 
-        seq = self._await_next(timeout)
-        view = np.ndarray(self.shape, self.dtype,
-                          buffer=self._shm.buf, offset=self._hdr)
-        out = view.copy()
+        while True:
+            seq = self._await_next(timeout)
+            view = np.ndarray(self.shape, self.dtype,
+                              buffer=self._shm.buf, offset=self._hdr)
+            out = view.copy()
+            if self._seq == seq:  # seqlock re-check: no concurrent write
+                break
         self._last = seq
         self._put(16 + 8 * self.reader_index, seq)
         return out
@@ -224,11 +241,12 @@ class TensorChannel(Channel):
                 f"{arr.shape} {arr.dtype}")
         seq = self._seq
         self._await_acks(seq, timeout)
+        self._put(0, seq + 1)  # odd: write in progress
         dest = np.ndarray(self.shape, self.dtype,
                           buffer=self._shm.buf, offset=self._hdr)
         dest[...] = arr
         self._put(8, arr.nbytes)
-        self._put(0, seq + 1)
+        self._put(0, seq + 2)  # even: release
 
     def reader(self, reader_index: int = 0) -> TensorChannelReader:
         if not 0 <= reader_index < self.num_readers:

@@ -1,22 +1,45 @@
 """Shared client/server marshalling: ObjectRefs cross the wire as
-markers that the server resolves against its per-client ref registry
-at unpickle time (so refs nested anywhere inside args work)."""
+markers that the server resolves against the ACTIVE client's ref table
+at unpickle time (so refs nested anywhere inside args work).
+
+The table is bound per-request via a contextvar — there is no global
+registry, so one client can never name (or guess) another client's
+refs and have the server resolve them.
+"""
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import io
 import pickle
 from typing import Any, Dict
 
-# server-side: set per-request to the active client's ref registry
-_resolver_registry: Dict[str, Any] = {}
+# server-side: the active client's {ref_hex: ObjectRef} table, bound for
+# the duration of each argument unpickle
+_active_table: contextvars.ContextVar[Dict[str, Any]] = \
+    contextvars.ContextVar("ray_tpu_client_ref_table")
+
+
+@contextlib.contextmanager
+def resolver_scope(table: Dict[str, Any]):
+    token = _active_table.set(table)
+    try:
+        yield
+    finally:
+        _active_table.reset(token)
 
 
 def _resolve_marker(ref_hex: str):
-    ref = _resolver_registry.get(ref_hex)
+    try:
+        table = _active_table.get()
+    except LookupError:
+        raise RuntimeError("client ref marker unpickled outside a "
+                           "resolver_scope") from None
+    ref = table.get(ref_hex)
     if ref is None:
-        raise KeyError(f"client ref {ref_hex} is not registered on the "
-                       f"server (already released?)")
+        raise KeyError(f"client ref {ref_hex} is not registered for this "
+                       f"client (already released?)")
     return ref
 
 

@@ -15,7 +15,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from ray_tpu.util.client.common import _resolver_registry
+from ray_tpu.util.client.common import resolver_scope
 
 logger = logging.getLogger("ray_tpu.client_server")
 
@@ -48,13 +48,19 @@ class ClientServer:
             out = []
             for r in refs:
                 table[r.hex()] = r
-                _resolver_registry[r.hex()] = r
                 out.append(r.hex())
         return out
 
-    def _load_args(self, args_bytes: bytes) -> Any:
-        # markers inside resolve against _resolver_registry at load time
-        return pickle.loads(args_bytes)
+    def _load_args(self, client_id: str, args_bytes: bytes) -> Any:
+        # ref markers inside resolve against THIS client's table only —
+        # per-client isolation, no cross-client ref guessing. The live
+        # table is bound without copying: dict reads are GIL-atomic and
+        # Release only pops keys (a concurrent release reads as the same
+        # KeyError a released ref would raise anyway).
+        with self._lock:
+            table = self._refs.setdefault(client_id, {})
+        with resolver_scope(table):
+            return pickle.loads(args_bytes)
 
     # -- RPC surface ----------------------------------------------------
     def Put(self, client_id: str, data: bytes) -> dict:
@@ -103,7 +109,7 @@ class ClientServer:
         from ray_tpu._private.serialization import loads_function
 
         fn = loads_function(fn_bytes)
-        args, kwargs = self._load_args(args_bytes)
+        args, kwargs = self._load_args(client_id, args_bytes)
         opts: dict = pickle.loads(opts_bytes)
         remote_fn = ray_tpu.remote(fn) if not opts else \
             ray_tpu.remote(fn).options(**opts)
@@ -117,7 +123,7 @@ class ClientServer:
         from ray_tpu._private.serialization import loads_function
 
         cls = loads_function(cls_bytes)
-        args, kwargs = self._load_args(args_bytes)
+        args, kwargs = self._load_args(client_id, args_bytes)
         opts: dict = pickle.loads(opts_bytes)
         actor_cls = ray_tpu.remote(cls)
         if opts:
@@ -152,7 +158,7 @@ class ClientServer:
             handle = self._actors.get(client_id, {}).get(actor_hex)
         if handle is None:
             return {"error": f"unknown actor {actor_hex}"}
-        args, kwargs = self._load_args(args_bytes)
+        args, kwargs = self._load_args(client_id, args_bytes)
         opts: dict = pickle.loads(opts_bytes) if opts_bytes else {}
         if opts.get("num_returns") == "streaming":
             return {"error": "streaming generators are not supported "
@@ -189,7 +195,6 @@ class ClientServer:
             table = self._refs.get(client_id, {})
             for h in ref_hexes:
                 table.pop(h, None)
-                _resolver_registry.pop(h, None)
         return {"ok": True}
 
     def ClusterInfo(self, client_id: str) -> dict:
@@ -209,8 +214,6 @@ class ClientServer:
 
         with self._lock:
             table = self._refs.pop(client_id, {})
-            for h in table:
-                _resolver_registry.pop(h, None)
             actors = self._actors.pop(client_id, {})
             owned = self._owned_actors.pop(client_id, set())
         killed = 0

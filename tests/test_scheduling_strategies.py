@@ -120,3 +120,34 @@ class TestNodeLabels:
             scheduling_strategy=NodeLabelSchedulingStrategy(
                 hard={"region": "mars"}, soft=True))
         assert len(ray_tpu.get(f.remote(), timeout=90)) > 0
+
+
+class TestHardConstraintSizing:
+    """A hard label constraint must land on a matching node whose TOTALS
+    fit the request — an undersized match must not read as infeasible
+    when a bigger match exists."""
+
+    def test_label_match_prefers_fitting_node(self):
+        # last class in the module: detach from the module fixture's
+        # cluster before bringing up our own
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster = Cluster()
+        cluster.add_node(num_cpus=1, labels={"pool": "a"})
+        big = cluster.add_node(num_cpus=4, labels={"pool": "a"})
+        cluster.wait_for_nodes()
+        ray_tpu.init(address=cluster.address)
+        try:
+            f = where.options(
+                num_cpus=3,
+                scheduling_strategy=NodeLabelSchedulingStrategy(
+                    hard={"pool": "a"}))
+            # several submissions: the random pick must never fail on the
+            # 1-CPU node (pre-fix it raced between infeasible and success)
+            refs = [f.remote() for _ in range(4)]
+            assert set(ray_tpu.get(refs, timeout=120)) == {big.node_id}
+        finally:
+            ray_tpu.shutdown()
+            cluster.shutdown()
