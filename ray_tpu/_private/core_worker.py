@@ -19,9 +19,9 @@ Object entry formats in the owner memory store:
 
 from __future__ import annotations
 
+import asyncio
 import functools
 import logging
-import queue as queue_mod
 import threading
 import time
 import uuid
@@ -94,39 +94,55 @@ class _LeaseEntry:
 
 
 class _ActorDispatcher:
-    """Ordered per-actor task dispatch (reference: ActorTaskSubmitter,
-    actor_task_submitter.cc:167 SubmitTask / :534 SendPendingTasks).
+    """Event-driven per-actor task dispatch on the core worker's io loop
+    (reference: ActorTaskSubmitter, actor_task_submitter.cc:167 SubmitTask
+    / :534 SendPendingTasks — every actor's submit queue is driven from one
+    io_context, with actor state PUSHED to the submitter, not polled).
 
-    One thread per (caller, actor). Tasks are sent in submission order and
-    the thread blocks on the *enqueue ack* (not execution), so per-caller
-    ordering holds without seqno windows — and therefore survives actor
-    restarts, where a fresh worker would otherwise wait forever for
-    pre-restart seqnos it never saw. Execution results come back
-    asynchronously via the caller's ``ActorTaskDone`` RPC. While tasks are
-    pending the same thread polls actor state so tasks lost to a dead
-    incarnation fail promptly instead of hanging.
+    No thread per actor: ``submit()`` appends to the send queue and wakes
+    an asyncio sender task shared per (caller, actor). The sender drains
+    the queue into ORDERED batches — one ``PushActorTasks`` RPC carries up
+    to ``_MAX_BATCH`` payloads — so a burst of small calls costs one
+    enqueue-ack round-trip per batch, not per call. Per-caller ordering
+    holds because batch N's enqueue ack is awaited before batch N+1 is
+    sent and the worker enqueues a batch in list order; no seqno windows,
+    so ordering survives actor restarts. Execution results come back
+    asynchronously via the caller's ``ActorTasksDone`` RPC.
+
+    While tasks are pending, ONE long-poll ``WaitActorUpdate`` watcher per
+    actor (GCS pushes state changes to it) detects death/restart the
+    moment it is published — replacing the old 1 s ``GetActorInfo``
+    polling threads; the same watcher requeries old pending tasks to
+    recover lost result pushes.
     """
 
-    _POLL_INTERVAL_S = 1.0
+    _MAX_BATCH = 64
     # pending tasks older than this on a healthy actor are re-queried at the
-    # worker (covers a lost ActorTaskDone delivery)
+    # worker (covers a lost ActorTasksDone delivery)
     _REQUERY_AGE_S = 10.0
 
     def __init__(self, core: "CoreWorker", aid: str):
         self.core = core
         self.aid = aid
-        self.queue: "queue_mod.Queue" = queue_mod.Queue()
         self._dead = False
+        self._closed = False
         self._state_lock = threading.Lock()
-        self.thread = threading.Thread(
-            target=self._loop, daemon=True, name=f"actor-dispatch-{aid[:8]}"
-        )
-        self.thread.start()
+        self._items: List[Tuple[dict, List[ObjectID]]] = []
+        self._loop = core.loop_thread.loop
+        self._wake = asyncio.Event()
+        self._watcher: Optional[asyncio.Task] = None
+        self._sender = asyncio.run_coroutine_threadsafe(
+            self._run(), self._loop)
+
+    @property
+    def alive(self) -> bool:
+        return not (self._dead or self._closed or self._sender.done())
 
     def submit(self, payload: dict, return_oids: List[ObjectID]) -> None:
         with self._state_lock:
-            if not self._dead:
-                self.queue.put((payload, return_oids))
+            if not self._dead and not self._closed:
+                self._items.append((payload, return_oids))
+                self._loop.call_soon_threadsafe(self._wake.set)
                 return
         self.core._fail_actor_task(
             TaskID(payload["task_id"]), return_oids,
@@ -134,9 +150,63 @@ class _ActorDispatcher:
         )
 
     def stop(self) -> None:
-        self.queue.put(None)
+        self._closed = True
+        try:
+            self._loop.call_soon_threadsafe(self._wake.set)
+        except RuntimeError:
+            pass  # loop already closed at shutdown
 
-    # -- internals ------------------------------------------------------
+    # -- sender (io loop) ----------------------------------------------
+    async def _run(self) -> None:
+        try:
+            while True:
+                try:
+                    await asyncio.wait_for(self._wake.wait(), timeout=5.0)
+                except asyncio.TimeoutError:
+                    pass
+                self._wake.clear()
+                if self._closed or self.core._shutdown:
+                    # fail anything still queued — a silent exit would
+                    # leave the tasks' return objects unresolved forever
+                    with self._state_lock:
+                        leftovers, self._items = self._items, []
+                    err = RayActorError(
+                        f"caller shut down before task reached actor "
+                        f"{self.aid[:12]}")
+                    for payload, oids in leftovers:
+                        self.core._fail_actor_task(
+                            TaskID(payload["task_id"]), oids, err)
+                    return
+                with self._state_lock:
+                    items, self._items = self._items, []
+                pos = 0
+                while pos < len(items) and not self._dead:
+                    batch = items[pos:pos + self._MAX_BATCH]
+                    try:
+                        await self._send_batch(batch)
+                    except BaseException as e:  # noqa: BLE001 — must survive
+                        logger.exception(
+                            "actor dispatch failed for %s", self.aid[:12])
+                        for payload, oids in batch:
+                            self.core._fail_actor_task(
+                                TaskID(payload["task_id"]), oids,
+                                RayActorError(
+                                    f"Failed to dispatch task to actor "
+                                    f"{self.aid[:12]}: {e!r}"))
+                    pos += self._MAX_BATCH
+                if self._dead:
+                    self._retire(items[pos:])
+                    return
+                # one persistent watcher per dispatcher, started at the
+                # first send — NOT per pending burst, which would cost a
+                # GCS round-trip per call on the sync path
+                if items and (self._watcher is None
+                              or self._watcher.done()):
+                    self._watcher = asyncio.ensure_future(self._watch())
+        finally:
+            if self._watcher is not None and not self._watcher.done():
+                self._watcher.cancel()
+
     def _has_pending(self) -> bool:
         with self.core._actor_pending_lock:
             return any(
@@ -144,170 +214,168 @@ class _ActorDispatcher:
                 for info in self.core._pending_actor_tasks.values()
             )
 
-    def _loop(self) -> None:
-        last_poll = 0.0
-        while not self.core._shutdown:
-            try:
-                item = self.queue.get(timeout=self._POLL_INTERVAL_S)
-            except queue_mod.Empty:
-                item = ()
-            now = time.monotonic()
-            if now - last_poll >= self._POLL_INTERVAL_S and self._has_pending():
-                try:
-                    self._poll_actor_state()
-                except Exception:  # noqa: BLE001 — poll is advisory
-                    pass
-                last_poll = now
-            if item == ():
-                continue
-            if item is None:
-                return
-            try:
-                self._send_one(*item)
-            except BaseException as e:  # noqa: BLE001 — the thread must survive
-                logger.exception("actor dispatch failed for %s", self.aid[:12])
-                self.core._fail_actor_task(
-                    TaskID(item[0]["task_id"]), item[1],
-                    RayActorError(f"Failed to dispatch task to actor {self.aid[:12]}: {e!r}"),
-                )
-            if self._dead:
-                self._retire()
-                return
-
-    def _retire(self) -> None:
-        """Actor is DEAD: fail queued work, deregister, end the thread."""
+    def _retire(self, leftovers) -> None:
+        """Actor is DEAD: fail queued work and deregister."""
         with self._state_lock:
             self._dead = True
-            items = []
-            while True:
-                try:
-                    items.append(self.queue.get_nowait())
-                except queue_mod.Empty:
-                    break
+            items = list(leftovers) + self._items
+            self._items = []
         err = ActorDiedError(f"Actor {self.aid[:12]} is dead")
-        for item in items:
-            if item:
-                self.core._fail_actor_task(TaskID(item[0]["task_id"]), item[1], err)
+        for payload, oids in items:
+            self.core._fail_actor_task(TaskID(payload["task_id"]), oids, err)
         with self.core._actor_disp_lock:
             if self.core._actor_dispatchers.get(self.aid) is self:
                 del self.core._actor_dispatchers[self.aid]
 
-    def _send_one(self, payload: dict, return_oids: List[ObjectID]) -> None:
-        tid = TaskID(payload["task_id"])
+    async def _send_batch(
+        self, batch: List[Tuple[dict, List[ObjectID]]],
+    ) -> None:
         deadline = time.monotonic() + config.actor_task_resend_timeout_s
+
+        def _fail_all(err: Exception) -> None:
+            for payload, oids in batch:
+                self.core._fail_actor_task(
+                    TaskID(payload["task_id"]), oids, err)
+
         while True:
             try:
-                addr = self.core._resolve_actor(self.aid)
+                addr = await self.core._resolve_actor_async(self.aid)
             except ActorDiedError as e:
                 self._dead = True
-                self.core._fail_actor_task(tid, return_oids, e)
+                _fail_all(e)
                 return
             except (ActorUnavailableError, RayActorError) as e:
-                self.core._fail_actor_task(tid, return_oids, e)
+                _fail_all(e)
                 return
             except Exception as e:  # noqa: BLE001 — e.g. GCS briefly down
                 if time.monotonic() > deadline:
-                    self.core._fail_actor_task(
-                        tid, return_oids,
-                        RayActorError(f"Could not resolve actor {self.aid[:12]}: {e}"),
-                    )
+                    _fail_all(RayActorError(
+                        f"Could not resolve actor {self.aid[:12]}: {e}"))
                     return
-                time.sleep(0.5)
+                await asyncio.sleep(0.5)
                 continue
             # register pending BEFORE the push: the done RPC can arrive
             # before the enqueue ack returns
+            now = time.monotonic()
             with self.core._actor_pending_lock:
-                self.core._pending_actor_tasks[tid] = {
-                    "aid": self.aid,
-                    "return_oids": return_oids,
-                    "addr": addr,
-                    "method": payload.get("method_name", "actor_task"),
-                    "ts": time.monotonic(),
-                }
+                for payload, oids in batch:
+                    self.core._pending_actor_tasks[
+                        TaskID(payload["task_id"])] = {
+                        "aid": self.aid,
+                        "return_oids": oids,
+                        "addr": addr,
+                        "method": payload.get("method_name", "actor_task"),
+                        "ts": now,
+                    }
             try:
-                reply = get_client(addr).call(
-                    "PushActorTask", payload=payload, timeout=30
+                reply = await get_client(addr).acall(
+                    "PushActorTasks",
+                    payloads=[p for p, _ in batch], timeout=30,
                 )
-            except (RpcConnectionError, ConnectionError, OSError, TimeoutError) as e:
-                with self.core._actor_pending_lock:
-                    self.core._pending_actor_tasks.pop(tid, None)
+            except (RpcConnectionError, ConnectionError, OSError,
+                    TimeoutError) as e:
+                self._unregister(batch)
                 # The push may or may not have reached the worker before the
                 # connection broke, so resending could execute it twice.
                 # Actor tasks are at-most-once (reference: actor tasks are
                 # not retried unless max_task_retries > 0) — report the
                 # fault (triggers restart per max_restarts) and fail THIS
-                # task; queued successors will reach the new incarnation.
-                self.core._report_actor_fault(self.aid, addr, str(e))
-                self.core._fail_actor_task(
-                    tid,
-                    return_oids,
-                    RayActorError(
-                        f"Actor {self.aid[:12]} became unreachable while "
-                        f"task {tid.hex()[:12]} was being delivered: {e}"
-                    ),
-                )
+                # batch; queued successors will reach the new incarnation.
+                await self.core._report_actor_fault_async(
+                    self.aid, addr, str(e))
+                _fail_all(RayActorError(
+                    f"Actor {self.aid[:12]} became unreachable while a "
+                    f"task batch was being delivered: {e}"))
                 return
             if not reply.get("accepted"):
                 # live worker without this actor: stale address (restart)
-                with self.core._actor_pending_lock:
-                    self.core._pending_actor_tasks.pop(tid, None)
+                self._unregister(batch)
                 self.core._invalidate_actor_addr(self.aid, addr)
                 if time.monotonic() > deadline:
-                    self.core._fail_actor_task(
-                        tid, return_oids,
-                        RayActorError(f"Actor {self.aid[:12]} not reachable at a stable address"),
-                    )
+                    _fail_all(RayActorError(
+                        f"Actor {self.aid[:12]} not reachable at a "
+                        f"stable address"))
                     return
-                time.sleep(0.2)
+                await asyncio.sleep(0.2)
                 continue
             return
 
-    def _poll_actor_state(self) -> None:
-        try:
-            info = self.core.gcs.call("GetActorInfo", actor_id=self.aid, timeout=5)
-        except Exception:
-            return
+    def _unregister(self, batch) -> None:
         with self.core._actor_pending_lock:
-            mine = {
-                t: i
-                for t, i in self.core._pending_actor_tasks.items()
-                if i["aid"] == self.aid
-            }
-        if info is None or info["state"] == "DEAD":
-            cause = (info or {}).get("death_cause", "actor no longer exists")
-            for t, i in mine.items():
-                self.core._fail_actor_task(
-                    t, i["return_oids"],
-                    ActorDiedError(f"Actor {self.aid[:12]} died: {cause}"),
-                )
-            self._dead = True  # _loop retires on next wake
-            return
-        current = tuple(info["worker_addr"]) if info.get("worker_addr") else None
-        now = time.monotonic()
-        for t, i in mine.items():
-            # enqueued on an incarnation that is gone → the task was lost
-            if i["addr"] != current:
-                self.core._fail_actor_task(
-                    t, i["return_oids"],
-                    RayActorError(
-                        f"Actor {self.aid[:12]} restarted; task {t.hex()[:12]} was lost"
-                    ),
-                )
-            elif now - i.get("ts", now) > self._REQUERY_AGE_S:
-                # healthy actor, old pending task: the ActorTaskDone push may
-                # have been lost — ask the worker directly
-                self._requery(t, i, current)
+            for payload, _ in batch:
+                self.core._pending_actor_tasks.pop(
+                    TaskID(payload["task_id"]), None)
 
-    def _requery(self, tid: TaskID, info: dict, addr: Tuple[str, int]) -> None:
+    # -- watcher (io loop): pushed actor state + lost-result recovery ---
+    async def _watch(self) -> None:
+        """One long-poll loop per dispatcher: the GCS pushes actor state
+        changes to it (reference: actor state pubsub channel). Costs one
+        GCS round-trip per ``timeout_s`` when nothing changes — constant,
+        independent of call rate."""
+        version = -1
+        while not (self._closed or self._dead or self.core._shutdown):
+            try:
+                info = await self.core.gcs.acall(
+                    "WaitActorUpdate", actor_id=self.aid,
+                    from_version=version, timeout_s=5.0, timeout=15,
+                )
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — GCS blip; retry
+                await asyncio.sleep(1.0)
+                continue
+            with self.core._actor_pending_lock:
+                mine = {
+                    t: i
+                    for t, i in self.core._pending_actor_tasks.items()
+                    if i["aid"] == self.aid
+                }
+            if info is None or info["state"] == "DEAD":
+                cause = (info or {}).get(
+                    "death_cause", "actor no longer exists")
+                for t, i in mine.items():
+                    self.core._fail_actor_task(
+                        t, i["return_oids"],
+                        ActorDiedError(
+                            f"Actor {self.aid[:12]} died: {cause}"))
+                self._dead = True
+                self._retire([])
+                return
+            version = info["version"]
+            current = tuple(info["worker_addr"]) \
+                if info.get("worker_addr") else None
+            now = time.monotonic()
+            for t, i in mine.items():
+                # enqueued on an incarnation that is gone → task was lost
+                if i["addr"] != current:
+                    self.core._fail_actor_task(
+                        t, i["return_oids"],
+                        RayActorError(
+                            f"Actor {self.aid[:12]} restarted; task "
+                            f"{t.hex()[:12]} was lost"))
+                elif now - i.get("ts", now) > self._REQUERY_AGE_S:
+                    # healthy actor, old pending task: the result push may
+                    # have been lost — ask the worker directly
+                    await self._requery(t, i, current)
+            if not mine and not self._has_pending():
+                # idle: stop long-polling the GCS (40k idle actors must
+                # not cost 8k RPC/s); _run re-arms us at the next send
+                return
+
+    async def _requery(
+        self, tid: TaskID, info: dict, addr: Tuple[str, int],
+    ) -> None:
         try:
-            reply = get_client(addr).call(
+            reply = await get_client(addr).acall(
                 "QueryActorTaskResult",
                 actor_id=self.aid,
                 task_id_bin=tid.binary(),
                 timeout=10,
             )
-        except Exception:
-            return  # connection-level failures are the poll's job
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001
+            return  # connection-level failures are the watcher's job
         status = reply.get("status")
         if status == "done":
             self.core._handle_actor_task_done(
@@ -320,8 +388,8 @@ class _ActorDispatcher:
             self.core._fail_actor_task(
                 tid, info["return_oids"],
                 RayActorError(
-                    f"Actor {self.aid[:12]} has no record of task {tid.hex()[:12]}; it was lost"
-                ),
+                    f"Actor {self.aid[:12]} has no record of task "
+                    f"{tid.hex()[:12]}; it was lost"),
             )
         # "running": leave it pending
 
@@ -344,7 +412,12 @@ class CoreWorker(CoreRuntime):
         self.is_driver = is_driver
         self.worker_id_hex = worker_id_hex or uuid.uuid4().hex
 
-        self.loop_thread = EventLoopThread(name="core-worker-io")
+        # ONE io loop per process (reference: the core worker's
+        # io_context drives clients, server, and actor submitters alike):
+        # sharing the global loop keeps get_client() connections, the
+        # owner server, and the actor dispatchers loop-affine — a second
+        # loop would cost two cross-thread handoffs per actor-task send
+        self.loop_thread = EventLoopThread.get_global()
         self.gcs = RpcClient(gcs_addr[0], gcs_addr[1], self.loop_thread)
         self.raylet = RpcClient(raylet_addr[0], raylet_addr[1], self.loop_thread)
         self.plasma = StoreClient(store_socket)
@@ -364,6 +437,7 @@ class CoreWorker(CoreRuntime):
         self.server.register("AddBorrower", self._handle_add_borrower)
         self.server.register("RemoveBorrower", self._handle_remove_borrower)
         self.server.register("ActorTaskDone", self._handle_actor_task_done)
+        self.server.register("ActorTasksDone", self._handle_actor_tasks_done)
         self.server.register("StreamingYield", self._handle_streaming_yield)
         self.server.register("StreamingDone", self._handle_streaming_done)
         self.server.register("StreamingCredit", self._handle_streaming_credit)
@@ -1135,6 +1209,20 @@ class CoreWorker(CoreRuntime):
         return out
 
     def free_object(self, oid: ObjectID) -> None:
+        # A refcount can hit zero from a coroutine on the io loop (e.g.
+        # _fail_actor_task in a dispatcher); the release path may block
+        # (plasma socket, GCS node lookup on a cold cache) — run it on
+        # the release pool so the loop never waits on itself.
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is not None:
+            self._borrow_release_pool.submit(self._free_object_sync, oid)
+            return
+        self._free_object_sync(oid)
+
+    def _free_object_sync(self, oid: ObjectID) -> None:
         with self._borrow_lock:
             inner = self._put_contained.pop(oid, None)
         if inner:
@@ -1930,18 +2018,31 @@ class CoreWorker(CoreRuntime):
             raise ValueError(reply["error"])
         return ActorID.from_hex(reply["actor_id"])
 
-    def _resolve_actor(self, actor_id_hex: str, wait_alive_s: float = 180.0) -> Tuple[str, int]:
-        # 180s: actor __init__ may legitimately cold-import jax and build
-        # a model inside a fresh worker process
+    async def _resolve_actor_async(
+        self, actor_id_hex: str, wait_alive_s: float = 180.0,
+    ) -> Tuple[str, int]:
+        """Resolve an actor's worker address via the GCS long-poll,
+        awaited on the io loop (blocking gcs.call there would deadlock
+        the loop against its own replies). 180s default: actor __init__
+        may legitimately cold-import jax and build a model inside a
+        fresh worker process."""
         deadline = time.monotonic() + wait_alive_s
         cached = self._actor_addr_cache.get(actor_id_hex)
         if cached is not None:
             return cached[0]
         version = -1
         while time.monotonic() < deadline:
-            info = self.gcs.call_retrying("WaitActorUpdate", actor_id=actor_id_hex, from_version=version, timeout_s=5.0, timeout=15)
+            try:
+                info = await self.gcs.acall(
+                    "WaitActorUpdate", actor_id=actor_id_hex,
+                    from_version=version, timeout_s=5.0, timeout=15)
+            except (RpcConnectionError, ConnectionError, OSError,
+                    TimeoutError):
+                await asyncio.sleep(0.5)
+                continue
             if info is None:
-                raise ActorDiedError(f"Actor {actor_id_hex[:12]} does not exist")
+                raise ActorDiedError(
+                    f"Actor {actor_id_hex[:12]} does not exist")
             version = info["version"]
             if info["state"] == "ALIVE" and info["worker_addr"]:
                 addr = tuple(info["worker_addr"])
@@ -1949,9 +2050,10 @@ class CoreWorker(CoreRuntime):
                 return addr
             if info["state"] == "DEAD":
                 raise ActorDiedError(
-                    f"Actor {actor_id_hex[:12]} is dead: {info.get('death_cause', '')}"
-                )
-        raise ActorUnavailableError(f"Actor {actor_id_hex[:12]} not schedulable in time")
+                    f"Actor {actor_id_hex[:12]} is dead: "
+                    f"{info.get('death_cause', '')}")
+        raise ActorUnavailableError(
+            f"Actor {actor_id_hex[:12]} not schedulable in time")
 
     def submit_actor_task(self, handle, method_name, args, kwargs, opts: TaskOptions):
         actor_id: ActorID = handle._actor_id
@@ -2009,10 +2111,16 @@ class CoreWorker(CoreRuntime):
     def _get_dispatcher(self, aid: str) -> _ActorDispatcher:
         with self._actor_disp_lock:
             disp = self._actor_dispatchers.get(aid)
-            if disp is None or not disp.thread.is_alive():
+            if disp is None or not disp.alive:
                 disp = _ActorDispatcher(self, aid)
                 self._actor_dispatchers[aid] = disp
             return disp
+
+    def _handle_actor_tasks_done(self, results: List[dict]) -> dict:
+        """Batched execution results pushed back by the actor's worker
+        (one RPC per delivery batch instead of one per task)."""
+        return {"ok": [self._handle_actor_task_done(**r).get("ok")
+                       for r in results]}
 
     def _handle_actor_task_done(
         self, task_id_bin: bytes, returns: List[dict], dropped_borrows: list = None,
@@ -2153,6 +2261,17 @@ class CoreWorker(CoreRuntime):
                 "ReportActorFault", actor_id=aid, worker_addr=addr, error=error
             )
         except Exception:
+            pass
+
+    async def _report_actor_fault_async(
+        self, aid: str, addr: Tuple[str, int], error: str,
+    ) -> None:
+        self._invalidate_actor_addr(aid, addr)
+        try:
+            await self.gcs.acall(
+                "ReportActorFault", actor_id=aid, worker_addr=addr,
+                error=error, timeout=15)
+        except Exception:  # noqa: BLE001 — advisory
             pass
 
     def _invalidate_actor_addr(self, aid: str, addr: Tuple[str, int]) -> None:

@@ -250,6 +250,16 @@ class RpcServer:
             pass
 
 
+_oneway_tasks: set = set()
+
+
+def _oneway_done(task) -> None:
+    _oneway_tasks.discard(task)
+    exc = task.exception() if not task.cancelled() else None
+    if exc is not None:
+        logger.debug("oneway rpc failed: %s", exc)
+
+
 class RpcClient:
     """Persistent connection with pipelined calls + reconnect/retry."""
 
@@ -342,9 +352,22 @@ class RpcClient:
         raise RpcConnectionError(f"rpc {method} to {self.host}:{self.port} failed after retries: {last}")
 
     def call_oneway(self, method: str, **kwargs) -> None:
-        self._loop_thread.run_coro(
-            self._call_async(method, kwargs, oneway=True, timeout=None), timeout=30
-        )
+        coro = self._call_async(method, kwargs, oneway=True, timeout=None)
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is self._loop_thread.loop:
+            # caller IS the io loop (e.g. a refcount release triggered
+            # from a dispatcher coroutine): blocking run_coro here would
+            # deadlock the loop on itself — fire and forget instead.
+            # Pin the task (asyncio holds only weak refs) so GC cannot
+            # collect it mid-flight, and drain its exception.
+            task = asyncio.ensure_future(coro)
+            _oneway_tasks.add(task)
+            task.add_done_callback(_oneway_done)
+            return
+        self._loop_thread.run_coro(coro, timeout=30)
 
     async def acall(self, method: str, timeout: Optional[float] = None, **kwargs) -> Any:
         """Async call, safe from ANY event loop: the I/O always executes on

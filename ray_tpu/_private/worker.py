@@ -183,9 +183,19 @@ def get(
     *,
     timeout: Optional[float] = None,
 ) -> Any:
+    from ray_tpu.dag_compiled import CompiledDAGRef
+
+    if isinstance(refs, CompiledDAGRef):
+        # compiled-DAG results live in channels, not the object store
+        return refs.get(timeout)
     w = _require_connected()
     single = isinstance(refs, ObjectRef)
     ref_list = [refs] if single else list(refs)
+    if any(isinstance(r, CompiledDAGRef) for r in ref_list):
+        if not all(isinstance(r, CompiledDAGRef) for r in ref_list):
+            raise TypeError(
+                "ray_tpu.get() cannot mix CompiledDAGRefs with ObjectRefs")
+        return [r.get(timeout) for r in ref_list]
     for r in ref_list:
         if not isinstance(r, ObjectRef):
             raise TypeError(f"ray_tpu.get() expects ObjectRef(s), got {type(r)}")

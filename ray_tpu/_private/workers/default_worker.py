@@ -15,6 +15,7 @@ The worker:
 
 from __future__ import annotations
 
+import functools
 import inspect
 import logging
 import os
@@ -35,6 +36,10 @@ from ray_tpu._private.serialization import deserialize, loads_function, serializ
 from ray_tpu.exceptions import RayActorError, RayTaskError
 
 logger = logging.getLogger("ray_tpu.worker")
+
+
+def _ray_call_shim(instance, fn, *args, **kwargs):
+    return fn(instance, *args, **kwargs)
 
 
 def _unpack_arg(a: dict) -> Any:
@@ -100,7 +105,13 @@ class _ActorRunner:
         sync methods execute serialized on the loop thread, preserving the
         actor's single-threaded state guarantee (reference: async actors
         run everything on the loop). Plain actors call on the pool thread."""
-        method = getattr(self.instance, method_name)
+        if method_name == "__ray_call__":
+            # fn(instance, *args, **kwargs) — arbitrary code against the
+            # actor (reference: ray's injected __ray_call__); used by
+            # create_collective_group and compiled-DAG exec loops
+            method = functools.partial(_ray_call_shim, self.instance)
+        else:
+            method = getattr(self.instance, method_name)
         if not self.is_async:
             return lambda args, kwargs: method(*args, **kwargs)
         import asyncio
@@ -165,36 +176,104 @@ class _ActorRunner:
             self.results[task_bin] = result
             while len(self.results) > self._RESULT_CACHE_MAX:
                 self.results.popitem(last=False)
-        caller_addr = tuple(payload["caller_addr"])
+        # hand the push to the shared deliverer: the execution thread must
+        # NOT block on a result round-trip (a 1-thread actor would
+        # serialize every call behind its predecessor's delivery), and
+        # batching pushes per caller costs one RPC per batch, not per task
+        _deliverer().deliver(self, tuple(payload["caller_addr"]), task_bin, {
+            "task_id_bin": task_bin,
+            "returns": result["returns"],
+            "dropped_borrows": result.get("dropped_borrows") or [],
+            # streaming methods: the done RPC is the reliable finalizer
+            # in case the StreamingDone push was lost
+            "streaming_done": result.get("streaming_done"),
+            "stream_error": result.get("stream_error"),
+            "failed": bool(result.get("retriable_error")
+                           or result.get("stream_error")),
+        })
+
+
+class _ResultDeliverer:
+    """Asynchronous, batched ActorTasksDone delivery (reference: the
+    direct worker→owner reply path of PushTask, core_worker.cc:3315 —
+    replies ride the io_context, never an execution thread).
+
+    Execution threads enqueue results; one drain task per caller on the
+    worker's io loop sends them in batches. On delivery failure after
+    retries the result stays in the runner's cache for the caller's
+    requery to collect."""
+
+    _MAX_BATCH = 64
+    _DELIVERY_ATTEMPTS = 4
+
+    def __init__(self, loop_thread):
+        self._loop = loop_thread.loop
+        self._queues: Dict[Tuple[str, int], list] = {}
+        self._draining: set = set()
+
+    def deliver(self, runner: "_ActorRunner", caller_addr: Tuple[str, int],
+                task_bin: bytes, result_kwargs: dict) -> None:
+        import asyncio
+
+        def _enqueue():
+            self._queues.setdefault(caller_addr, []).append(
+                (runner, task_bin, result_kwargs))
+            if caller_addr not in self._draining:
+                self._draining.add(caller_addr)
+                asyncio.ensure_future(self._drain(caller_addr))
+
+        self._loop.call_soon_threadsafe(_enqueue)
+
+    async def _drain(self, addr: Tuple[str, int]) -> None:
+        try:
+            while True:
+                q = self._queues.get(addr)
+                if not q:
+                    return  # no await between this check and finally:
+                    # a racing _enqueue can't slip past the discard
+                batch = q[: self._MAX_BATCH]
+                del q[: self._MAX_BATCH]
+                await self._send(addr, batch)
+        finally:
+            self._draining.discard(addr)
+
+    async def _send(self, addr: Tuple[str, int], batch: list) -> None:
+        import asyncio
+
         delay = 0.5
         for attempt in range(self._DELIVERY_ATTEMPTS):
             try:
-                get_client(caller_addr).call(
-                    "ActorTaskDone",
-                    task_id_bin=task_bin,
-                    returns=result["returns"],
-                    dropped_borrows=result.get("dropped_borrows") or [],
-                    # streaming methods: the done RPC is the reliable
-                    # finalizer in case the StreamingDone push was lost
-                    streaming_done=result.get("streaming_done"),
-                    stream_error=result.get("stream_error"),
-                    failed=bool(result.get("retriable_error")
-                                or result.get("stream_error")),
-                    timeout=30,
-                )
-                with self.lock:
-                    self.results.pop(task_bin, None)
-                return
+                await get_client(addr).acall(
+                    "ActorTasksDone",
+                    results=[kw for _, _, kw in batch], timeout=30)
             except Exception as e:  # noqa: BLE001
                 if attempt == self._DELIVERY_ATTEMPTS - 1:
-                    # leave the result cached; the caller's requery poll
-                    # will collect it if the caller is still alive
+                    # leave results cached; the caller's requery will
+                    # collect them if the caller is still alive
                     logger.warning(
-                        "could not deliver actor task result to %s: %s", caller_addr, e
-                    )
-                else:
-                    time.sleep(delay)
-                    delay *= 2
+                        "could not deliver %d actor task result(s) to "
+                        "%s: %s", len(batch), addr, e)
+                    return
+                await asyncio.sleep(delay)
+                delay *= 2
+            else:
+                for runner, task_bin, _ in batch:
+                    with runner.lock:
+                        runner.results.pop(task_bin, None)
+                return
+
+
+_DELIVERER: Optional[_ResultDeliverer] = None
+_DELIVERER_LOCK = threading.Lock()
+
+
+def _deliverer() -> _ResultDeliverer:
+    with _DELIVERER_LOCK:
+        global _DELIVERER
+        if _DELIVERER is None:
+            _DELIVERER = _ResultDeliverer(
+                worker_mod.global_worker.core.loop_thread)
+        return _DELIVERER
 
 
 def _resolve_args(packed_args: List[dict], packed_kwargs: Dict[str, dict]) -> Tuple[tuple, dict]:
@@ -393,6 +472,7 @@ class WorkerServer:
         core.server.register("CancelTask", self.CancelTask)
         core.server.register("CreateActor", self.CreateActor)
         core.server.register("PushActorTask", self.PushActorTask)
+        core.server.register("PushActorTasks", self.PushActorTasks)
         core.server.register("QueryActorTaskResult", self.QueryActorTaskResult)
         core.server.register("KillActor", self.KillActor)
         core.server.register("SetLeaseContext", self.SetLeaseContext)
@@ -552,6 +632,18 @@ class WorkerServer:
         if runner is None or runner.dead:
             return {"accepted": False}
         runner.submit(payload)
+        return {"accepted": True}
+
+    def PushActorTasks(self, payloads: List[dict]) -> dict:
+        """Batched enqueue-and-ack (one RPC per caller batch): payloads
+        enqueue in list order, preserving per-caller submission order."""
+        if not payloads:
+            return {"accepted": True}
+        runner = self.actors.get(payloads[0]["actor_id"])
+        if runner is None or runner.dead:
+            return {"accepted": False}
+        for p in payloads:
+            runner.submit(p)
         return {"accepted": True}
 
     def QueryActorTaskResult(self, actor_id: str, task_id_bin: bytes) -> dict:

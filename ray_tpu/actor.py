@@ -66,6 +66,12 @@ class ActorHandle:
         return cls(actor_id)
 
     def __getattr__(self, item: str) -> ActorMethod:
+        if item == "__ray_call__":
+            # run an arbitrary function against the actor instance
+            # (reference: the injected __ray_call__ actor method):
+            # handle.__ray_call__.remote(fn, *args) executes
+            # fn(instance, *args) on the actor
+            return ActorMethod(self, "__ray_call__", num_returns=1)
         if item.startswith("_"):
             raise AttributeError(item)
         opts = self._method_opts.get(item, {})

@@ -1,0 +1,160 @@
+"""Channel-compiled DAG tests (reference: compiled_dag_node.py:813 —
+steady-state execution over shared-memory channels, no task submission
+per execute; VERDICT round 3 item 4)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode, MultiOutputNode
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Stage:
+    def __init__(self, add):
+        self.add = add
+        self.calls = 0
+
+    def step(self, x):
+        self.calls += 1
+        return x + self.add
+
+    def ncalls(self):
+        return self.calls
+
+
+def test_channel_mode_three_actor_pipeline(cluster):
+    with InputNode() as inp:
+        dag = Stage.bind(3).step.bind(
+            Stage.bind(2).step.bind(Stage.bind(1).step.bind(inp)))
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled._channel_mode, "channel mode should engage locally"
+        for i in range(5):
+            assert ray_tpu.get(compiled.execute(i), timeout=60) == i + 6
+    finally:
+        compiled.teardown()
+
+
+def test_channel_dag_10x_faster_than_taskpath(cluster):
+    """VERDICT acceptance: >=10x lower per-execute latency than the
+    uncompiled DAG on a 3-actor pipeline."""
+    with InputNode() as inp:
+        dag = Stage.bind(3).step.bind(
+            Stage.bind(2).step.bind(Stage.bind(1).step.bind(inp)))
+
+    # uncompiled: every execute() submits 3 actor tasks + resolves refs
+    uncompiled_dag = dag
+    ray_tpu.get(uncompiled_dag.execute(0), timeout=120)  # warm actors
+    n = 20
+    t0 = time.perf_counter()
+    for i in range(n):
+        ray_tpu.get(uncompiled_dag.execute(i), timeout=120)
+    task_path = (time.perf_counter() - t0) / n
+
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled._channel_mode
+        ray_tpu.get(compiled.execute(0), timeout=60)  # warm loops
+        t0 = time.perf_counter()
+        for i in range(n):
+            ray_tpu.get(compiled.execute(i), timeout=60)
+        chan_path = (time.perf_counter() - t0) / n
+    finally:
+        compiled.teardown()
+    speedup = task_path / chan_path
+    print(f"task-path {task_path*1e3:.2f} ms/exec, "
+          f"channel {chan_path*1e3:.2f} ms/exec, {speedup:.1f}x")
+    assert speedup >= 10.0, (
+        f"expected >=10x, got {speedup:.1f}x "
+        f"({task_path*1e3:.2f} -> {chan_path*1e3:.2f} ms)")
+
+
+def test_channel_dag_multi_output_and_errors(cluster):
+    @ray_tpu.remote
+    class Worker:
+        def ok(self, x):
+            return x * 2
+
+        def boom(self, x):
+            raise ValueError("dag boom")
+
+    with InputNode() as inp:
+        a = Worker.bind()
+        b = Worker.bind()
+        dag = MultiOutputNode([a.ok.bind(inp), b.ok.bind(inp)])
+    compiled = dag.experimental_compile()
+    try:
+        assert ray_tpu.get(compiled.execute(4), timeout=60) == [8, 8]
+        assert ray_tpu.get(compiled.execute(5), timeout=60) == [10, 10]
+    finally:
+        compiled.teardown()
+
+    with InputNode() as inp:
+        w = Worker.bind()
+        dag2 = w.ok.bind(w.boom.bind(inp))
+    compiled2 = dag2.experimental_compile()
+    try:
+        with pytest.raises(Exception, match="dag boom"):
+            ray_tpu.get(compiled2.execute(1), timeout=60)
+        # the loop survives a user exception: next execute still works...
+        with pytest.raises(Exception, match="dag boom"):
+            ray_tpu.get(compiled2.execute(2), timeout=60)
+    finally:
+        compiled2.teardown()
+
+
+def test_channel_dag_oversized_value_is_per_execute_error(cluster):
+    """A value bigger than the channel slot surfaces as that execute's
+    error; the loop (and later executes) survive."""
+    @ray_tpu.remote
+    class Big:
+        def step(self, n):
+            return b"x" * n
+
+    with InputNode() as inp:
+        dag = Big.bind().step.bind(inp)
+    compiled = dag.experimental_compile(buffer_size_bytes=1 << 16)
+    try:
+        assert compiled._channel_mode
+        assert len(ray_tpu.get(compiled.execute(10), timeout=60)) == 10
+        with pytest.raises(Exception, match="exceeds channel capacity"):
+            ray_tpu.get(compiled.execute(1 << 20), timeout=60)
+        # loop survived the oversize — next execute works
+        assert len(ray_tpu.get(compiled.execute(20), timeout=60)) == 20
+    finally:
+        compiled.teardown()
+
+
+def test_channel_dag_get_list_of_refs(cluster):
+    with InputNode() as inp:
+        dag = Stage.bind(1).step.bind(inp)
+    compiled = dag.experimental_compile()
+    try:
+        refs = [compiled.execute(1), compiled.execute(2)]
+        assert ray_tpu.get(refs, timeout=60) == [2, 3]
+    finally:
+        compiled.teardown()
+
+
+def test_channel_dag_pipelined_executes(cluster):
+    """Two executes in flight; results arrive in order via the cursor."""
+    with InputNode() as inp:
+        dag = Stage.bind(1).step.bind(inp)
+    compiled = dag.experimental_compile()
+    try:
+        r0 = compiled.execute(10)
+        r1 = compiled.execute(20)
+        # out-of-order get: r1 first — cursor caches r0's value
+        assert r1.get(timeout=60) == 21
+        assert r0.get(timeout=60) == 11
+    finally:
+        compiled.teardown()
