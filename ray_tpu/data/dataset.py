@@ -43,11 +43,29 @@ class _Op:
 
 
 class _MapBlocks(_Op):
-    """Per-block transform (map/map_batches/filter/flat_map fuse here)."""
+    """Per-block transform (map/map_batches/filter/flat_map fuse here).
+    ``concurrency`` (optional) caps the stage's in-flight task budget;
+    a fused chain runs at the smallest cap any member requested."""
 
-    def __init__(self, fn: Callable[[Block], Block], name: str):
+    def __init__(self, fn: Callable[[Block], Block], name: str,
+                 concurrency: Optional[int] = None):
         self.fn = fn
         self.name = name
+        self.concurrency = concurrency
+
+
+class _ActorMapBlocks(_Op):
+    """Stateful per-block transform on an actor pool (reference:
+    map_operator.py:196 actor pool — ``compute`` with a callable class):
+    ``cls()`` is constructed once per pool actor, ``wrapper(instance,
+    block)`` applies it to each block. Never fuses with neighbors."""
+
+    def __init__(self, cls: type, wrapper: Callable, name: str,
+                 concurrency: int):
+        self.cls = cls
+        self.wrapper = wrapper
+        self.name = name
+        self.concurrency = concurrency
 
 
 class _Shuffle(_Op):
@@ -95,22 +113,36 @@ class Dataset:
     ) -> "Dataset":
         """Apply fn to batches (reference: dataset.py:531). With
         batch_size=None the whole block is one batch (fastest on TPU —
-        blocks are already sized for the store)."""
+        blocks are already sized for the store).
+
+        ``fn`` may be a callable CLASS (reference: actor compute
+        strategy): it is constructed once per pool actor and reused
+        across blocks — pass ``concurrency`` (in ``**_ignored`` kwargs)
+        to size the pool."""
         kw = fn_kwargs or {}
 
-        def _apply(block: Block) -> Block:
+        def _call_batches(call, block: Block) -> Block:
             if not block_num_rows(block):
                 return block
             if batch_size is None:
-                return normalize_batch(fn(to_batch_format(block, batch_format), **kw))
+                return normalize_batch(call(to_batch_format(block, batch_format), **kw))
             outs = []
             n = block_num_rows(block)
             for s in range(0, n, batch_size):
                 piece = block_slice(block, s, min(s + batch_size, n))
-                outs.append(normalize_batch(fn(to_batch_format(piece, batch_format), **kw)))
+                outs.append(normalize_batch(call(to_batch_format(piece, batch_format), **kw)))
             return block_concat(outs)
 
-        return self._with(_MapBlocks(_apply, f"MapBatches({getattr(fn, '__name__', 'fn')})"))
+        name = f"MapBatches({getattr(fn, '__name__', 'fn')})"
+        concurrency = _normalize_concurrency(_ignored.get("concurrency"))
+        if isinstance(fn, type):
+            return self._with(_ActorMapBlocks(
+                fn, _call_batches, name, concurrency or 2))
+
+        def _apply(block: Block) -> Block:
+            return _call_batches(fn, block)
+
+        return self._with(_MapBlocks(_apply, name, concurrency=concurrency))
 
     def map(self, fn: Callable) -> "Dataset":
         def _apply(block: Block) -> Block:
@@ -255,26 +287,47 @@ class Dataset:
     # -- execution -----------------------------------------------------
     def _iter_output_refs(self) -> Iterator[Any]:
         """Execute the plan, yielding output block refs streamingly.
-        Consecutive _MapBlocks fuse into one task per block."""
+
+        Consecutive _MapBlocks fuse into one task per block; runs of
+        map stages (fused chains + actor-pool stages) execute on the
+        STREAMING executor — an operator graph whose stages run
+        concurrently with per-op in-flight budgets and object-store
+        backpressure (reference: streaming_executor.py:100). Shuffles
+        are barriers between streaming segments."""
         refs: Iterator[Any] = iter(self._source_refs)
         i = 0
         ops = self._ops
         local = _use_local_exec()
         while i < len(ops):
             op = ops[i]
-            if isinstance(op, _MapBlocks):
-                fused = [op.fn]
-                j = i + 1
-                while j < len(ops) and isinstance(ops[j], _MapBlocks):
-                    fused.append(ops[j].fn)
-                    j += 1
+            if isinstance(op, (_MapBlocks, _ActorMapBlocks)):
+                # collect the maximal run of map-like stages into one
+                # streaming segment
+                phys: List[Any] = []
+                j = i
+                while j < len(ops):
+                    if isinstance(ops[j], _MapBlocks):
+                        fused = [ops[j].fn]
+                        caps = [ops[j].concurrency]
+                        j += 1
+                        while j < len(ops) and isinstance(ops[j], _MapBlocks):
+                            fused.append(ops[j].fn)
+                            caps.append(ops[j].concurrency)
+                            j += 1
 
-                def chain(block, fns=tuple(fused)):
-                    for f in fns:
-                        block = f(block)
-                    return block
+                        def chain(block, fns=tuple(fused)):
+                            for f in fns:
+                                block = f(block)
+                            return block
 
-                refs = self._executor.map_refs(chain, refs, local=local)
+                        caps = [c for c in caps if c]
+                        phys.append(("fn", chain, min(caps) if caps else None))
+                    elif isinstance(ops[j], _ActorMapBlocks):
+                        phys.append(("actor", ops[j], None))
+                        j += 1
+                    else:
+                        break
+                refs = self._run_map_segment(phys, refs, local)
                 i = j
             elif isinstance(op, _Shuffle):
                 in_refs = list(refs)
@@ -292,6 +345,43 @@ class Dataset:
             else:
                 raise TypeError(op)
         return refs
+
+    def _run_map_segment(self, phys: List[Any], refs: Iterator[Any],
+                         local: bool) -> Iterator[Any]:
+        if local:
+            # in-process short circuit: construct actor classes once,
+            # map serially
+            out = refs
+            for kind, payload, _ in phys:
+                if kind == "fn":
+                    out = self._executor.map_refs(payload, out, local=True)
+                else:
+                    inst = payload.cls()
+                    wrapper = payload.wrapper
+                    out = self._executor.map_refs(
+                        functools.partial(wrapper, inst), out, local=True)
+            return out
+        from ray_tpu.data._internal.streaming_executor import (
+            MapOp,
+            StreamingExecutor,
+        )
+
+        map_ops: List[MapOp] = []
+        for kind, payload, cap in phys:
+            if kind == "fn":
+                from ray_tpu.data._internal.streaming_executor import (
+                    DEFAULT_OP_CONCURRENCY,
+                )
+
+                map_ops.append(MapOp(
+                    "map", fn=payload,
+                    concurrency=cap or DEFAULT_OP_CONCURRENCY))
+            else:
+                map_ops.append(MapOp(
+                    payload.name, actor_cls=payload.cls,
+                    actor_wrapper=payload.wrapper,
+                    concurrency=payload.concurrency))
+        return StreamingExecutor(map_ops).execute(refs)
 
     def iter_blocks(self) -> Iterator[Block]:
         for r in self._iter_output_refs():
@@ -535,6 +625,16 @@ def _write_block_file(block: Block, path: str, fmt: str) -> str:
     else:
         raise ValueError(f"unknown format {fmt}")
     return path
+
+
+def _normalize_concurrency(c) -> Optional[int]:
+    """Accept the reference's forms: int, or (min, max) autoscaling tuple
+    (we size the pool at the upper bound)."""
+    if c is None:
+        return None
+    if isinstance(c, (tuple, list)):
+        return int(max(c))
+    return int(c)
 
 
 def _limit_refs(refs: Iterator[Any], n: int) -> Iterator[Any]:

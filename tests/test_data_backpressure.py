@@ -1,0 +1,40 @@
+"""Object-store-pressure backpressure for the streaming Data executor
+(reference: backpressure_policy/ + resource-manager store budget).
+Own module: it brings up a dedicated small-store cluster and must not
+share the standard module-scoped cluster fixture."""
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu import data as rdata
+
+
+def test_streaming_bounded_memory_small_store():
+    """VERDICT acceptance: a pipeline whose total data exceeds the object
+    store completes under backpressure, with allocation held below
+    capacity while iterating."""
+    try:
+        ray_tpu.shutdown()
+    except Exception:
+        pass
+    cap = 64 * 1024 * 1024
+    ray_tpu.init(num_cpus=4, object_store_memory=cap,
+                 ignore_reinit_error=True)
+    try:
+        from ray_tpu._private import worker as wm
+
+        plasma = wm.global_worker.core.plasma
+        # 32 blocks x ~8MB = 256MB total through a 64MB store
+        ds = rdata.range(32 * 1_000_000 // 1000, override_num_blocks=32) \
+            .map_batches(lambda b: {
+                "x": np.repeat(b["id"].astype(np.float64), 1000)})
+        peak = 0
+        rows = 0
+        for blk in ds.iter_blocks():
+            rows += len(blk["x"])
+            m = plasma.metrics()
+            peak = max(peak, m["allocated"])
+        assert rows == 32 * 1000 * 1000
+        assert peak <= cap, f"allocated {peak} exceeded capacity {cap}"
+    finally:
+        ray_tpu.shutdown()

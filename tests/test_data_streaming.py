@@ -4,6 +4,7 @@ round-trips, and the streaming read->transform->shuffle->iterate pipeline
 read_api.py:1128 parquet, streaming_executor.py:100)."""
 
 import os
+import time
 
 import numpy as np
 import pytest
@@ -99,3 +100,47 @@ def test_iter_jax_batches_from_pipeline(cluster):
     batches = list(ds.iter_jax_batches(batch_size=128))
     assert len(batches) == 4
     assert float(sum(b["x"].sum() for b in batches)) == float(np.arange(512).sum())
+
+
+# ---------------------------------------------------------------------------
+# streaming executor (reference: streaming_executor.py:100,
+# backpressure_policy/, map_operator.py:196 actor pools)
+# ---------------------------------------------------------------------------
+def test_streaming_stage_overlap(cluster, tmp_path):
+    """VERDICT acceptance: stage 2 starts processing early blocks while
+    stage 1 is still processing later blocks (no barrier between map
+    stages of a read -> map_batches -> ingest pipeline)."""
+    src = rdata.range(16 * 64, override_num_blocks=16).materialize()
+    src.write_parquet(str(tmp_path / "pq"))
+
+    def stage1(b):
+        time.sleep(0.3)
+        out = dict(b)
+        out["t1_end"] = np.full(len(b["id"]), time.time())
+        return out
+
+    class Stage2:
+        """Stateful: exercised via the actor-pool map operator."""
+
+        def __init__(self):
+            self.blocks = 0
+
+        def __call__(self, b):
+            self.blocks += 1
+            time.sleep(0.3)
+            out = dict(b)
+            out["t2_start"] = np.full(len(b["id"]), time.time())
+            return out
+
+    ds = (rdata.read_parquet(str(tmp_path / "pq"))
+          .map_batches(stage1)
+          .map_batches(Stage2, concurrency=2))
+    t1_end, t2_start = [], []
+    for batch in ds.iter_batches(batch_size=None):
+        t1_end.append(batch["t1_end"].max())
+        t2_start.append(batch["t2_start"].min())
+    assert len(t1_end) == 16
+    # overlap: some stage-2 work began BEFORE the last stage-1 block done
+    assert min(t2_start) < max(t1_end), (
+        f"stages ran serially: first t2 {min(t2_start):.3f} >= "
+        f"last t1 {max(t1_end):.3f}")
