@@ -18,6 +18,7 @@ import asyncio
 import logging
 import os
 import pickle
+import struct
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
@@ -130,7 +131,18 @@ class GcsServer:
         self.autoscaler_enabled_until = 0.0
         self._dirty = False
         self._needs_replay_reschedule = False
+        self._wal = None  # lazily-opened append handle
+        self._wal_records = 0
         self._load_persisted()
+        if self._replay_wal():
+            logger.info("replayed %d WAL records", self._wal_records)
+            for a in self.actors.values():
+                a.lease_in_flight = False
+            # a restart restored state (possibly WAL-only, before any
+            # snapshot existed): pending work needs rescheduling
+            self._needs_replay_reschedule = True
+            # fold replayed records into a fresh snapshot right away
+            self._compact()
         self.server.register_instance(self)
 
     # ------------------------------------------------------------------
@@ -211,21 +223,140 @@ class GcsServer:
     # mutations (a 100MB working_dir must not re-serialize per flush).
     _BLOB_NAMESPACES = ("runtime_env_packages",)
 
-    def _persist(self, immediate: bool = False) -> None:
-        """Mark dirty; critical mutations (actor/PG/job registration, KV
-        writes) flush before acknowledging so a crash right after the
-        reply cannot lose acknowledged state. High-frequency updates
-        (actor state churn) coalesce into the 0.5s flush loop."""
+    # -- write-ahead log (reference: redis_store_client.h semantics —
+    # every durable table mutation is written through BEFORE the state
+    # is acknowledged; here an fsync'd append log + periodic snapshot
+    # compaction replaces Redis) --------------------------------------
+    _WAL_COMPACT_RECORDS = 2000
+
+    def _wal_path(self) -> str:
+        return self.storage_path + ".wal"
+
+    def _wal_file(self):
+        if self._wal is None:
+            self._wal = open(self._wal_path(), "ab")
+        return self._wal
+
+    def _log(self, kind: str, *payload: Any) -> None:
+        """Append one durable mutation to the WAL (fsync'd): a crash at
+        ANY point after the ack replays the mutation on restart —
+        nothing acknowledged is ever lost between snapshots."""
+        if not self.storage_path:
+            return
+        try:
+            rec = pickle.dumps((kind, payload))
+            f = self._wal_file()
+            f.write(struct.pack("<I", len(rec)))
+            f.write(rec)
+            f.flush()
+            os.fsync(f.fileno())
+        except Exception:
+            logger.exception("WAL append failed")
+            # the mutation is acknowledged but not on disk: mark for the
+            # compaction safety net so a later snapshot captures it
+            self._dirty = True
+            return
+        self._wal_records += 1
+        if self._wal_records >= self._WAL_COMPACT_RECORDS:
+            self._compact()
+
+    def _compact(self) -> None:
+        """Fold the WAL into a fresh snapshot and truncate it. Crash
+        between the snapshot replace and the truncate replays WAL
+        records on top of a snapshot that already contains them —
+        harmless, records are full-row idempotent."""
         self._dirty = True
-        if immediate:
-            self._flush()
+        if not self._flush():
+            # snapshot failed (e.g. disk full): keep the WAL — truncating
+            # would discard the only durable copy of acknowledged state
+            return
+        try:
+            if self._wal is not None:
+                self._wal.close()
+            self._wal = open(self._wal_path(), "wb")
+            self._wal.close()
+            self._wal = None
+        except Exception:
+            logger.exception("WAL truncate failed")
+        self._wal_records = 0
+
+    def _replay_wal(self) -> int:
+        path = self._wal_path()
+        if not os.path.exists(path):
+            return 0
+        n = 0
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+            off = 0
+            while off + 4 <= len(data):
+                (ln,) = struct.unpack_from("<I", data, off)
+                if off + 4 + ln > len(data):
+                    break  # torn tail record from a mid-write crash
+                kind, payload = pickle.loads(data[off + 4: off + 4 + ln])
+                self._apply_wal(kind, payload)
+                off += 4 + ln
+                n += 1
+        except Exception:
+            logger.exception("WAL replay failed at record %d", n)
+        self._wal_records = n
+        return n
+
+    def _apply_wal(self, kind: str, payload: tuple) -> None:
+        if kind == "actor":
+            a = payload[0]
+            self.actors[a.actor_id] = a
+        elif kind == "named":
+            ns, name, aid = payload
+            self.named_actors[(ns, name)] = aid
+        elif kind == "pg":
+            pg = payload[0]
+            self.placement_groups[pg.pg_id] = pg
+        elif kind == "job":
+            jid, info = payload
+            self.jobs[jid] = info
+        elif kind == "job_counter":
+            self._job_counter = max(self._job_counter, payload[0])
+        elif kind == "kv":
+            ns, key, value = payload
+            self.kv.setdefault(ns, {})[key] = value
+        elif kind == "kv_blob":
+            ns, key = payload
+            try:
+                with open(os.path.join(self._blob_dir(), ns + "." + key),
+                          "rb") as f:
+                    self.kv.setdefault(ns, {})[key] = f.read()
+            except OSError:
+                logger.warning("WAL blob %s/%s missing", ns, key)
+        elif kind == "kv_del":
+            ns, key = payload
+            self.kv.get(ns, {}).pop(key, None)
+        else:
+            logger.warning("unknown WAL record kind %r", kind)
+
+    def _log_kv(self, ns: str, key: str, value: bytes) -> None:
+        """KV mutations route large blob namespaces to the side files
+        (content-addressed, write-once) so the WAL stays small."""
+        if ns in self._BLOB_NAMESPACES and self.storage_path:
+            bd = self._blob_dir()
+            os.makedirs(bd, exist_ok=True)
+            p = os.path.join(bd, ns + "." + key)
+            if not os.path.exists(p):
+                with open(p + ".tmp", "wb") as f:
+                    f.write(value)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(p + ".tmp", p)
+            self._log("kv_blob", ns, key)
+        else:
+            self._log("kv", ns, key, value)
 
     def _blob_dir(self) -> str:
         return self.storage_path + ".blobs"
 
-    def _flush(self) -> None:
+    def _flush(self) -> bool:
         if not (self.storage_path and self._dirty):
-            return
+            return False
         self._dirty = False
         kv_snap: Dict[str, Any] = {}
         try:
@@ -253,10 +384,15 @@ class GcsServer:
             tmp = self.storage_path + ".tmp"
             with open(tmp, "wb") as f:
                 pickle.dump(snap, f)
+                f.flush()
+                os.fsync(f.fileno())  # the WAL is truncated on the
+                # strength of this snapshot — it must actually be on disk
             os.replace(tmp, self.storage_path)
         except Exception:
             logger.exception("state snapshot failed")
             self._dirty = True
+            return False
+        return True
 
     def _load_blobs(self) -> None:
         for ns, table in list(self.kv.items()):
@@ -273,9 +409,12 @@ class GcsServer:
                 self.kv[ns] = loaded
 
     async def _flush_loop(self) -> None:
+        # periodic compaction safety net: bounds WAL replay time even
+        # under a steady mutation trickle that never hits the record cap
         while True:
-            await asyncio.sleep(0.5)
-            self._flush()
+            await asyncio.sleep(30.0)
+            if self._wal_records or self._dirty:
+                self._compact()
 
     def _raylet(self, node_id: str) -> RpcClient:
         c = self._raylet_clients.get(node_id)
@@ -467,14 +606,15 @@ class GcsServer:
             "state": "RUNNING",
             "metadata": metadata or {},
         }
-        self._persist(immediate=True)
+        self._log("job_counter", self._job_counter)
+        self._log("job", job_id, self.jobs[job_id])
         return {"job_id_int": job_id_int, "job_id": job_id}
 
     async def MarkJobFinished(self, job_id: str) -> dict:
         if job_id in self.jobs:
             self.jobs[job_id]["state"] = "FINISHED"
             self.jobs[job_id]["end_time"] = time.time()
-            self._persist()
+            self._log("job", job_id, self.jobs[job_id])
         # non-detached actors owned by the job die with it
         for actor in list(self.actors.values()):
             if actor.job_id == job_id and not actor.detached and actor.state != "DEAD":
@@ -492,7 +632,7 @@ class GcsServer:
         if not overwrite and key in table:
             return {"added": False}
         table[key] = value
-        self._persist(immediate=True)
+        self._log_kv(ns, key, value)
         return {"added": True}
 
     async def KVGet(self, ns: str, key: str) -> Optional[bytes]:
@@ -505,7 +645,7 @@ class GcsServer:
                 os.unlink(os.path.join(self._blob_dir(), ns + "." + key))
             except OSError:
                 pass
-        self._persist(immediate=True)
+        self._log("kv_del", ns, key)
         return {"ok": True}
 
     async def KVKeys(self, ns: str, prefix: str = "") -> List[str]:
@@ -572,9 +712,10 @@ class GcsServer:
             node_labels=dict(node_labels) if node_labels else None,
         )
         self.actors[actor_id] = actor
+        self._log("actor", actor)
         if name:
             self.named_actors[(namespace, name)] = actor_id
-        self._persist(immediate=True)
+            self._log("named", namespace, name, actor_id)
         asyncio.ensure_future(self._schedule_actor(actor))
         return {"actor_id": actor_id, "existing": False}
 
@@ -759,7 +900,8 @@ class GcsServer:
             "actor_state", actor_id,
             {"state": a.state, "version": a.version} if a else None,
         )
-        self._persist()  # every actor state change is a durable mutation
+        if a is not None:
+            self._log("actor", a)  # every state change is durable
 
     async def GetActorInfo(self, actor_id: str) -> Optional[dict]:
         a = self.actors.get(actor_id)
@@ -896,7 +1038,7 @@ class GcsServer:
             creator_job=creator_job,
         )
         self.placement_groups[pg_id] = pg
-        self._persist(immediate=True)
+        self._log("pg", pg)
         asyncio.ensure_future(self._schedule_pg(pg))
         return {"pg_id": pg_id}
 
@@ -993,7 +1135,7 @@ class GcsServer:
                 await self._raylet(nid).acall("CommitBundle", pg_id=pg.pg_id, bundle_index=idx)
             pg.bundle_nodes = plan
             pg.state = "CREATED"
-            self._persist()
+            self._log("pg", pg)
             logger.info("placement group %s created: %s", pg.pg_id[:12], {i: n[:8] for i, n in plan.items()})
             return
         if pg.state == "PENDING":
@@ -1022,8 +1164,9 @@ class GcsServer:
             except Exception:
                 pass
         pg.state = "REMOVED"
-        self._persist()
         pg.bundle_nodes = {}
+        self._log("pg", pg)  # after the clear: replay must not
+        # resurrect stale bundle->node assignments
         return {"ok": True}
 
     # ------------------------------------------------------------------

@@ -111,7 +111,11 @@ class MapOp:
         the inter-stage backpressure bound (an upstream op must not
         produce blocks its consumer has no room to queue)."""
         submitted = False
-        while self.pending_in and len(self.inflight) < self.concurrency:
+        while self.pending_in and \
+                len(self.inflight) + self._backlog() < self.concurrency:
+            # _backlog counts completed-but-unemitted outputs: under
+            # preserve_order a head-of-line straggler must throttle new
+            # submissions, not let the ready set grow without bound
             if len(self.inflight) >= max(0, downstream_free):
                 break
             if under_pressure and not (force_one and not submitted):
@@ -159,6 +163,9 @@ class MapOp:
             while self._unordered_ready:
                 out.append(self._unordered_ready.popleft())
         return out
+
+    def _backlog(self) -> int:
+        return len(self._ready) + len(self._unordered_ready)
 
     def exhausted(self) -> bool:
         return (self.input_done and not self.pending_in

@@ -109,6 +109,7 @@ class Dataset:
         batch_format: Optional[str] = None,
         batch_size: Optional[int] = None,
         fn_kwargs: Optional[Dict] = None,
+        concurrency: Optional[Union[int, Tuple[int, int]]] = None,
         **_ignored,
     ) -> "Dataset":
         """Apply fn to batches (reference: dataset.py:531). With
@@ -117,8 +118,9 @@ class Dataset:
 
         ``fn`` may be a callable CLASS (reference: actor compute
         strategy): it is constructed once per pool actor and reused
-        across blocks — pass ``concurrency`` (in ``**_ignored`` kwargs)
-        to size the pool."""
+        across blocks — ``concurrency`` (int, or the reference's
+        (min, max) form; we size at the max) sets the pool size, or the
+        in-flight task budget for plain functions."""
         kw = fn_kwargs or {}
 
         def _call_batches(call, block: Block) -> Block:
@@ -134,7 +136,7 @@ class Dataset:
             return block_concat(outs)
 
         name = f"MapBatches({getattr(fn, '__name__', 'fn')})"
-        concurrency = _normalize_concurrency(_ignored.get("concurrency"))
+        concurrency = _normalize_concurrency(concurrency)
         if isinstance(fn, type):
             return self._with(_ActorMapBlocks(
                 fn, _call_batches, name, concurrency or 2))

@@ -114,7 +114,7 @@ def test_streaming_stage_overlap(cluster, tmp_path):
     src.write_parquet(str(tmp_path / "pq"))
 
     def stage1(b):
-        time.sleep(0.3)
+        time.sleep(0.5)
         out = dict(b)
         out["t1_end"] = np.full(len(b["id"]), time.time())
         return out
@@ -127,7 +127,7 @@ def test_streaming_stage_overlap(cluster, tmp_path):
 
         def __call__(self, b):
             self.blocks += 1
-            time.sleep(0.3)
+            time.sleep(0.1)
             out = dict(b)
             out["t2_start"] = np.full(len(b["id"]), time.time())
             return out
