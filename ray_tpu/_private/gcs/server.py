@@ -134,14 +134,19 @@ class GcsServer:
         self._wal = None  # lazily-opened append handle
         self._wal_records = 0
         self._load_persisted()
-        if self._replay_wal():
+        replayed, had_wal = self._replay_wal()
+        if replayed:
             logger.info("replayed %d WAL records", self._wal_records)
             for a in self.actors.values():
                 a.lease_in_flight = False
             # a restart restored state (possibly WAL-only, before any
             # snapshot existed): pending work needs rescheduling
             self._needs_replay_reschedule = True
-            # fold replayed records into a fresh snapshot right away
+        if had_wal:
+            # fold into a fresh snapshot + truncate — ALSO when zero
+            # records replayed: a torn first record must not linger as
+            # garbage that later appends would land after
+            self._dirty = True
             self._compact()
         self.server.register_instance(self)
 
@@ -280,10 +285,14 @@ class GcsServer:
             logger.exception("WAL truncate failed")
         self._wal_records = 0
 
-    def _replay_wal(self) -> int:
+    def _replay_wal(self) -> Tuple[int, bool]:
+        """Returns (records replayed, wal file existed). A torn or
+        corrupt tail stops replay at the last intact record; the caller
+        compacts, which truncates the garbage (records beyond a torn
+        length prefix are unrecoverable — the framing chain is broken)."""
         path = self._wal_path()
         if not os.path.exists(path):
-            return 0
+            return 0, False
         n = 0
         try:
             with open(path, "rb") as f:
@@ -300,12 +309,31 @@ class GcsServer:
         except Exception:
             logger.exception("WAL replay failed at record %d", n)
         self._wal_records = n
-        return n
+        return n, True
+
+    # the mutable ActorInfo fields a state transition can touch — the
+    # slim "actor_state" record carries only these, not the (possibly
+    # huge) serialized creation spec logged once at registration
+    _ACTOR_STATE_FIELDS = ("state", "version", "worker_addr", "node_id",
+                           "worker_id", "num_restarts", "death_cause")
+
+    def _log_actor_state(self, a: "ActorInfo") -> None:
+        self._log("actor_state", a.actor_id,
+                  {f: getattr(a, f) for f in self._ACTOR_STATE_FIELDS})
 
     def _apply_wal(self, kind: str, payload: tuple) -> None:
         if kind == "actor":
             a = payload[0]
             self.actors[a.actor_id] = a
+        elif kind == "actor_state":
+            aid, fields = payload
+            a = self.actors.get(aid)
+            if a is None:
+                logger.warning("WAL actor_state for unknown actor %s",
+                               aid[:12])
+            else:
+                for f, v in fields.items():
+                    setattr(a, f, v)
         elif kind == "named":
             ns, name, aid = payload
             self.named_actors[(ns, name)] = aid
@@ -901,7 +929,8 @@ class GcsServer:
             {"state": a.state, "version": a.version} if a else None,
         )
         if a is not None:
-            self._log("actor", a)  # every state change is durable
+            self._log_actor_state(a)  # every state change is durable;
+            # slim record — the full spec was logged at registration
 
     async def GetActorInfo(self, actor_id: str) -> Optional[dict]:
         a = self.actors.get(actor_id)

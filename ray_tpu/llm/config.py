@@ -49,6 +49,10 @@ class LLMConfig:
     batch_max_size: int = 8
     batch_wait_timeout_s: float = 0.05
     resources: Optional[dict] = None  # e.g. {"TPU": 1}
+    # iteration-level scheduling over a fixed-slot KV cache (vLLM-style);
+    # False falls back to @serve.batch whole-batch generation
+    continuous_batching: bool = True
+    cache_slots: int = 8
 
     def get_tokenizer(self):
         return self.tokenizer if self.tokenizer is not None else ByteTokenizer()

@@ -51,20 +51,67 @@ class LLMEngine:
         return self.generator.generate(
             [list(p) for p in prompts], sampling, seed=self.next_seed())
 
-    def generate(self, prompts: Sequence[Union[str, Sequence[int]]],
-                 sampling: Optional[SamplingParams] = None) -> List[str]:
-        """Text in → text out (token-id prompts pass through encode)."""
+    def _with_eos(self, sampling: SamplingParams) -> SamplingParams:
         tok = self.tokenizer
-        sampling = sampling or self.config.sampling
         if sampling.stop_token_id is None and \
                 getattr(tok, "eos_token_id", None) is not None:
             import dataclasses
 
             sampling = dataclasses.replace(
                 sampling, stop_token_id=tok.eos_token_id)
+        return sampling
+
+    def generate(self, prompts: Sequence[Union[str, Sequence[int]]],
+                 sampling: Optional[SamplingParams] = None) -> List[str]:
+        """Text in → text out (token-id prompts pass through encode)."""
+        tok = self.tokenizer
+        sampling = self._with_eos(sampling or self.config.sampling)
         ids = [tok.encode(p) if isinstance(p, str) else list(p)
                for p in prompts]
         # empty prompts would index position -1 at prefill; give them BOS=0
         ids = [p if p else [0] for p in ids]
         outs = self.generate_tokens(ids, sampling)
         return [tok.decode(o) for o in outs]
+
+
+class ContinuousLLMEngine(LLMEngine):
+    """Engine whose device loop is a ContinuousBatcher: concurrent
+    callers share decode steps, new requests join the running batch the
+    moment a slot frees (reference: vLLM iteration-level scheduling —
+    models/continuous_batching.py is the TPU-native core)."""
+
+    def __init__(self, config: LLMConfig):
+        super().__init__(config)
+        from ray_tpu.models.continuous_batching import ContinuousBatcher
+
+        self.batcher = ContinuousBatcher(
+            self.model_config, self.generator.params,
+            max_len=config.max_len, slots=config.cache_slots,
+            seed=config.seed)
+
+    def submit(self, prompt: Union[str, Sequence[int]],
+               sampling: Optional[SamplingParams] = None):
+        """Thread-safe; returns a Future resolving to the completion
+        TEXT."""
+        from concurrent.futures import Future
+
+        tok = self.tokenizer
+        sampling = self._with_eos(sampling or self.config.sampling)
+        ids = tok.encode(prompt) if isinstance(prompt, str) else list(prompt)
+        inner = self.batcher.submit(ids or [0], sampling)
+        out: Future = Future()
+        inner.add_done_callback(lambda f: out.set_exception(f.exception())
+                                if f.exception() is not None
+                                else out.set_result(tok.decode(f.result())))
+        return out
+
+    def submit_stream(self, prompt: Union[str, Sequence[int]],
+                      sampling: Optional[SamplingParams] = None):
+        """Yields token ids as the batcher emits them."""
+        tok = self.tokenizer
+        sampling = self._with_eos(sampling or self.config.sampling)
+        ids = tok.encode(prompt) if isinstance(prompt, str) else list(prompt)
+        return self.batcher.submit_stream(ids or [0], sampling)
+
+    def shutdown(self) -> None:
+        self.batcher.shutdown()

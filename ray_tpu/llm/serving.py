@@ -26,14 +26,22 @@ def build_llm_deployment(config: LLMConfig):
     @serve.deployment(
         name=config.name,
         num_replicas=config.num_replicas,
+        # concurrent handlers feed the continuous batcher's one device
+        # loop — the replica must accept overlapping requests
+        max_ongoing_requests=max(8, config.cache_slots * 2),
         ray_actor_options=(
             {"resources": config.resources} if config.resources else None),
     )
     class LLMServer:
         def __init__(self):
-            from ray_tpu.llm.engine import LLMEngine
+            if config.continuous_batching:
+                from ray_tpu.llm.engine import ContinuousLLMEngine
 
-            self.engine = LLMEngine(config)
+                self.engine = ContinuousLLMEngine(config)
+            else:
+                from ray_tpu.llm.engine import LLMEngine
+
+                self.engine = LLMEngine(config)
             self.tokenizer = self.engine.tokenizer
 
         @serve.batch(max_batch_size=config.batch_max_size,
@@ -42,7 +50,16 @@ def build_llm_deployment(config: LLMConfig):
             return self.engine.generate(prompts)
 
         def __call__(self, prompt: str) -> str:
+            if config.continuous_batching:
+                # iteration-level scheduling: this request joins the
+                # running decode batch the moment a KV slot frees
+                return self.engine.submit(prompt).result()
             return self._generate_batch(prompt)
+
+        def engine_stats(self) -> dict:
+            st = getattr(getattr(self.engine, "batcher", None), "stats",
+                         None)
+            return dict(st) if st is not None else {}
 
         def generate_stream(self, prompt: str,
                             max_tokens: Optional[int] = None):
@@ -55,10 +72,14 @@ def build_llm_deployment(config: LLMConfig):
             if sampling.stop_token_id is None and eos is not None:
                 sampling = dataclasses.replace(sampling, stop_token_id=eos)
             ids = self.tokenizer.encode(prompt)
+            if config.continuous_batching:
+                stream = self.engine.submit_stream(ids, sampling)
+            else:
+                stream = self.engine.generator.generate_stream(
+                    ids, sampling, seed=self.engine.next_seed())
             out_ids = []
             prev_text = ""
-            for t in self.engine.generator.generate_stream(
-                    ids, sampling, seed=self.engine.next_seed()):
+            for t in stream:
                 out_ids.append(t)
                 text = self.tokenizer.decode(out_ids)
                 delta, prev_text = text[len(prev_text):], text
