@@ -133,6 +133,7 @@ class GcsServer:
         self._needs_replay_reschedule = False
         self._wal = None  # lazily-opened append handle
         self._wal_records = 0
+        self._wal_degraded = False  # an append failed since last compact
         self._load_persisted()
         replayed, had_wal = self._replay_wal()
         if replayed:
@@ -258,8 +259,11 @@ class GcsServer:
         except Exception:
             logger.exception("WAL append failed")
             # the mutation is acknowledged but not on disk: mark for the
-            # compaction safety net so a later snapshot captures it
+            # compaction safety net so a later snapshot captures it, and
+            # degrade to FULL actor records (slim actor_state deltas
+            # need their base record to replay) until compaction
             self._dirty = True
+            self._wal_degraded = True
             return
         self._wal_records += 1
         if self._wal_records >= self._WAL_COMPACT_RECORDS:
@@ -284,6 +288,7 @@ class GcsServer:
         except Exception:
             logger.exception("WAL truncate failed")
         self._wal_records = 0
+        self._wal_degraded = False
 
     def _replay_wal(self) -> Tuple[int, bool]:
         """Returns (records replayed, wal file existed). A torn or
@@ -318,6 +323,11 @@ class GcsServer:
                            "worker_id", "num_restarts", "death_cause")
 
     def _log_actor_state(self, a: "ActorInfo") -> None:
+        if self._wal_degraded:
+            # a lost append may have been this actor's base record;
+            # full rows keep replay self-contained until compaction
+            self._log("actor", a)
+            return
         self._log("actor_state", a.actor_id,
                   {f: getattr(a, f) for f in self._ACTOR_STATE_FIELDS})
 

@@ -100,9 +100,21 @@ class ContinuousLLMEngine(LLMEngine):
         ids = tok.encode(prompt) if isinstance(prompt, str) else list(prompt)
         inner = self.batcher.submit(ids or [0], sampling)
         out: Future = Future()
-        inner.add_done_callback(lambda f: out.set_exception(f.exception())
-                                if f.exception() is not None
-                                else out.set_result(tok.decode(f.result())))
+
+        def _chain(f):
+            # concurrent.futures swallows callback exceptions: a decode
+            # failure must still resolve `out` or the caller hangs
+            try:
+                exc = f.exception()
+                if exc is not None:
+                    out.set_exception(exc)
+                else:
+                    out.set_result(tok.decode(f.result()))
+            except BaseException as e:  # noqa: BLE001
+                if not out.done():
+                    out.set_exception(e)
+
+        inner.add_done_callback(_chain)
         return out
 
     def submit_stream(self, prompt: Union[str, Sequence[int]],

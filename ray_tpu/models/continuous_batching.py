@@ -119,6 +119,8 @@ class ContinuousBatcher:
     def submit(self, tokens: Sequence[int],
                sampling: Optional[SamplingParams] = None) -> Future:
         """Thread-safe: enqueue one request; resolves to List[int]."""
+        if self._shutdown:
+            raise RuntimeError("ContinuousBatcher was shut down")
         fut: Future = Future()
         req = _Request(list(tokens) or [0], sampling or SamplingParams(),
                        fut, None)
@@ -130,6 +132,8 @@ class ContinuousBatcher:
     def submit_stream(self, tokens: Sequence[int],
                       sampling: Optional[SamplingParams] = None):
         """Yields token ids as they are emitted."""
+        if self._shutdown:
+            raise RuntimeError("ContinuousBatcher was shut down")
         q: queue.Queue = queue.Queue()
         req = _Request(list(tokens) or [0], sampling or SamplingParams(),
                        None, q)
@@ -145,6 +149,21 @@ class ContinuousBatcher:
     def shutdown(self) -> None:
         self._shutdown = True
         self._wake.set()
+        self._thread.join(timeout=10.0)
+        # outstanding work can never run now: resolve it with an error
+        # instead of hanging its callers
+        err = RuntimeError("ContinuousBatcher was shut down")
+        leftovers = list(self._active.values())
+        while not self._waiting.empty():
+            try:
+                leftovers.append(self._waiting.get_nowait())
+            except queue.Empty:
+                break
+        for req in leftovers:
+            if req.future is not None and not req.future.done():
+                req.future.set_exception(err)
+            if req.stream_q is not None:
+                req.stream_q.put(None)
 
     def _check_len(self, req: _Request) -> None:
         if len(req.tokens) >= self.max_len:
@@ -275,9 +294,10 @@ class ContinuousBatcher:
             if len(req.out) >= req.sampling.max_tokens:
                 done = True
         # prompt_len + emitted tokens occupy the row; the NEXT decode
-        # writes at position lengths[slot] which must stay < max_len
+        # writes at position lengths[slot], which must stay < max_len —
+        # matching Generator.generate's lengths >= max_len stop
         if not done and req.slot >= 0:
-            if self._host_len[req.slot] + 1 >= self.max_len:
+            if self._host_len[req.slot] >= self.max_len:
                 done = True
         if done:
             self._retire(req)

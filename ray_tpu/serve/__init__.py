@@ -6,6 +6,8 @@ provides ingress.
 """
 
 from ray_tpu.serve.batching import batch
+from ray_tpu.serve.grpc_proxy import start_grpc_proxy, stop_grpc_proxy
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.controller import (
     delete,
     get_app_handle,
@@ -31,9 +33,13 @@ __all__ = [
     "delete",
     "deployment",
     "get_app_handle",
+    "get_multiplexed_model_id",
+    "multiplexed",
     "run",
     "shutdown",
+    "start_grpc_proxy",
     "start_http_proxy",
     "status",
+    "stop_grpc_proxy",
     "stop_http_proxy",
 ]

@@ -47,9 +47,12 @@ class Replica:
         self._loop = None
         self._loop_lock = threading.Lock()
 
-    def _maybe_await(self, out):
+    def _maybe_await(self, out, model_id: str = ""):
         """Async deployment callables run on a per-replica event loop
-        (reference: replicas are fully async in serve/_private/replica.py)."""
+        (reference: replicas are fully async in serve/_private/replica.py).
+        The multiplexed model id is re-set INSIDE the coroutine: the Task
+        created on the loop thread copies that thread's context, not the
+        request thread's, so the contextvar would otherwise read empty."""
         import asyncio
         import inspect
 
@@ -62,21 +65,54 @@ class Replica:
                     target=self._loop.run_forever, daemon=True,
                     name="replica-loop",
                 ).start()
-        return asyncio.run_coroutine_threadsafe(out, self._loop).result()
 
-    def handle_request(self, method: str, args, kwargs):
-        if method == "__call__":
-            return self._maybe_await(self._callable(*args, **kwargs))
-        return self._maybe_await(getattr(self._callable, method)(*args, **kwargs))
+        async def _with_model_id():
+            from ray_tpu.serve.multiplex import _current_model_id
 
-    def handle_request_streaming(self, method: str, args, kwargs):
+            token = _current_model_id.set(model_id)
+            try:
+                return await out
+            finally:
+                _current_model_id.reset(token)
+
+        return asyncio.run_coroutine_threadsafe(
+            _with_model_id(), self._loop).result()
+
+    def handle_request(self, method: str, args, kwargs,
+                       multiplexed_model_id: str = ""):
+        from ray_tpu.serve.multiplex import _current_model_id
+
+        token = _current_model_id.set(multiplexed_model_id)
+        try:
+            if method == "__call__":
+                return self._maybe_await(self._callable(*args, **kwargs),
+                                         multiplexed_model_id)
+            return self._maybe_await(
+                getattr(self._callable, method)(*args, **kwargs),
+                multiplexed_model_id)
+        finally:
+            _current_model_id.reset(token)
+
+    def handle_request_streaming(self, method: str, args, kwargs,
+                                 multiplexed_model_id: str = ""):
         """Generator method: the actor-streaming machinery turns each yield
         into an ObjectRefGenerator item on the caller (replica.py:1630)."""
-        if method == "__call__":
-            out = self._callable(*args, **kwargs)
-        else:
-            out = getattr(self._callable, method)(*args, **kwargs)
-        yield from out
+        from ray_tpu.serve.multiplex import _current_model_id
+
+        token = _current_model_id.set(multiplexed_model_id)
+        try:
+            if method == "__call__":
+                out = self._callable(*args, **kwargs)
+            else:
+                out = getattr(self._callable, method)(*args, **kwargs)
+            yield from out
+        finally:
+            _current_model_id.reset(token)
+
+    def multiplexed_model_ids(self) -> list:
+        from ray_tpu.serve.multiplex import replica_multiplexed_model_ids
+
+        return replica_multiplexed_model_ids(self._callable)
 
     def reconfigure(self, user_config: Dict) -> bool:
         if hasattr(self._callable, "reconfigure"):
@@ -328,10 +364,18 @@ def _controller():
 
 
 def run(app: Application, *, name: Optional[str] = None,
-        route_prefix: Optional[str] = None, **_ignored) -> DeploymentHandle:
+        route_prefix: Optional[str] = None,
+        local_testing_mode: bool = False, **_ignored) -> DeploymentHandle:
     """Deploy the application; returns a live-updating handle
-    (reference: serve.run api.py:930)."""
+    (reference: serve.run api.py:930). ``local_testing_mode`` runs the
+    deployment in-process with no cluster (reference:
+    serve/_private/local_testing_mode.py)."""
     import inspect
+
+    if local_testing_mode:
+        from ray_tpu.serve.local_mode import run_local
+
+        return run_local(app)
 
     from ray_tpu._private.serialization import dumps_function
 

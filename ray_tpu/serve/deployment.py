@@ -105,18 +105,38 @@ class _ReplicaSet:
         self.max_ongoing = max_ongoing
         self.outstanding = [0] * len(actors)
         self.lock = threading.Lock()
+        # model id -> replica idx: cache-aware routing for multiplexed
+        # models (reference: multiplexed model routing prefers replicas
+        # that already hold the model). Learned from this handle's own
+        # routing; dies with the replica set, so scaling resets it.
+        self.model_affinity: Dict[str, int] = {}
 
     def pick(self) -> int:
         """Power-of-two-choices by outstanding count
         (reference: pow_2_router.py:27)."""
         with self.lock:
-            n = len(self.actors)
-            if n == 1:
-                idx = 0
-            else:
-                i, j = random.sample(range(n), 2)
-                idx = i if self.outstanding[i] <= self.outstanding[j] else j
-            self.outstanding[idx] += 1
+            return self._pick_locked()
+
+    def _pick_locked(self) -> int:
+        n = len(self.actors)
+        if n == 1:
+            idx = 0
+        else:
+            i, j = random.sample(range(n), 2)
+            idx = i if self.outstanding[i] <= self.outstanding[j] else j
+        self.outstanding[idx] += 1
+        return idx
+
+    def pick_for_model(self, model_id: str) -> int:
+        """Prefer the replica that already loaded model_id; fall back to
+        pow-2 (and remember the choice) on a cold model."""
+        with self.lock:
+            idx = self.model_affinity.get(model_id)
+            if idx is not None and 0 <= idx < len(self.actors):
+                self.outstanding[idx] += 1
+                return idx
+            idx = self._pick_locked()
+            self.model_affinity[model_id] = idx
             return idx
 
     def release(self, idx: int) -> None:
@@ -200,21 +220,29 @@ class DeploymentHandle:
             raise AttributeError(method)
         return _HandleMethod(self, method)
 
+    def options(self, *, multiplexed_model_id: str = "",
+                **_ignored) -> "_HandleOptions":
+        """Per-call options (reference: handle.options):
+        multiplexed_model_id routes to a replica that already holds the
+        model and sets serve.get_multiplexed_model_id() there."""
+        return _HandleOptions(self, multiplexed_model_id)
+
     def remote(self, *args, **kwargs):
         return _HandleMethod(self, "__call__").remote(*args, **kwargs)
 
-    def _call(self, method: str, args, kwargs):
+    def _call(self, method: str, args, kwargs, model_id: str = ""):
         rs = self._rs
-        idx = rs.pick()
+        idx = rs.pick_for_model(model_id) if model_id else rs.pick()
         actor = rs.actors[idx]
         if method in self._streaming_methods:
-            gen = actor.handle_request_streaming.remote(method, args, kwargs)
+            gen = actor.handle_request_streaming.remote(
+                method, args, kwargs, model_id)
             # the stream holds the routing slot until it completes or is
             # dropped — otherwise streaming load is invisible to pow-2
             # routing and the autoscaler
             gen._set_close_callback(lambda: rs.release(idx))
             return gen
-        ref = actor.handle_request.remote(method, args, kwargs)
+        ref = actor.handle_request.remote(method, args, kwargs, model_id)
         return DeploymentResponse(ref, on_done=lambda: rs.release(idx))
 
     def __reduce__(self):
@@ -273,9 +301,29 @@ def _rebuild_handle(name: str) -> DeploymentHandle:
 
 
 class _HandleMethod:
-    def __init__(self, handle: DeploymentHandle, method: str):
+    def __init__(self, handle: DeploymentHandle, method: str,
+                 model_id: str = ""):
         self._handle = handle
         self._method = method
+        self._model_id = model_id
 
     def remote(self, *args, **kwargs):
-        return self._handle._call(self._method, args, kwargs)
+        return self._handle._call(self._method, args, kwargs,
+                                  self._model_id)
+
+
+class _HandleOptions:
+    """handle.options(multiplexed_model_id=...) view."""
+
+    def __init__(self, handle: DeploymentHandle, model_id: str):
+        self._handle = handle
+        self._model_id = model_id
+
+    def __getattr__(self, method: str) -> _HandleMethod:
+        if method.startswith("_"):
+            raise AttributeError(method)
+        return _HandleMethod(self._handle, method, self._model_id)
+
+    def remote(self, *args, **kwargs):
+        return _HandleMethod(self._handle, "__call__",
+                             self._model_id).remote(*args, **kwargs)

@@ -1,0 +1,160 @@
+"""gRPC ingress (reference: serve/_private/proxy.py:520 gRPCProxy — a
+second protocol through the same router as HTTP).
+
+Generic service, no compiled .proto needed: the gRPC method path names
+the deployment and handler — ``/<deployment>/<method>`` — the request
+message is a pickled ``(args, kwargs)`` tuple (or raw bytes treated as
+a single positional argument), and the response is the pickled result.
+Generator handlers stream one message per yield. The metadata key
+``multiplexed_model_id`` routes to a model-holding replica exactly like
+``handle.options(multiplexed_model_id=...)``.
+
+Python client:
+
+    ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+    call = ch.unary_unary("/my_app/__call__")
+    result = pickle.loads(call(pickle.dumps(((arg,), {}))))
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import threading
+from concurrent import futures
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger("ray_tpu.serve.grpc")
+
+_PROXY_LOCK = threading.Lock()
+_PROXY: Optional["_GrpcProxy"] = None
+
+
+def _load_request(data: bytes):
+    try:
+        args, kwargs = pickle.loads(data)
+        if isinstance(args, tuple) and isinstance(kwargs, dict):
+            return args, kwargs
+    except Exception:  # noqa: BLE001
+        pass
+    return (data,), {}  # raw payload as one positional arg
+
+
+class _GrpcProxy:
+    def __init__(self, host: str, port: int):
+        import grpc
+
+        self._handles: Dict[str, Any] = {}
+        self._hlock = threading.Lock()
+
+        proxy = self
+
+        class _Generic(grpc.GenericRpcHandler):
+            def service(self, handler_call_details):
+                parts = handler_call_details.method.strip("/").split("/")
+                if len(parts) != 2:
+                    return None
+                dep, method = parts
+                md = dict(handler_call_details.invocation_metadata or ())
+                model_id = md.get("multiplexed_model_id", "")
+
+                def unary(request, context):
+                    return proxy._call_unary(dep, method, request,
+                                             context, model_id)
+
+                def stream(request, context):
+                    yield from proxy._call_stream(dep, method, request,
+                                                  context, model_id)
+
+                if proxy._is_streaming(dep, method):
+                    return grpc.unary_stream_rpc_method_handler(
+                        stream, request_deserializer=None,
+                        response_serializer=None)
+                return grpc.unary_unary_rpc_method_handler(
+                    unary, request_deserializer=None,
+                    response_serializer=None)
+
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=32))
+        self._server.add_generic_rpc_handlers((_Generic(),))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        self._server.start()
+        logger.info("gRPC proxy on :%d", self.port)
+
+    def _get_handle(self, name: str):
+        with self._hlock:
+            h = self._handles.get(name)
+            if h is None:
+                from ray_tpu.serve.controller import get_app_handle
+
+                h = get_app_handle(name)
+                self._handles[name] = h
+            return h
+
+    def _is_streaming(self, dep: str, method: str) -> bool:
+        try:
+            return method in self._get_handle(dep)._streaming_methods
+        except Exception:  # noqa: BLE001
+            return False
+
+    def _target(self, dep: str, method: str, context, model_id: str):
+        import grpc
+
+        try:
+            handle = self._get_handle(dep)
+        except Exception as e:  # noqa: BLE001
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"no deployment {dep!r}: {e}")
+        target = handle.options(multiplexed_model_id=model_id) \
+            if model_id else handle
+        return target if method == "__call__" \
+            else getattr(target, method)
+
+    def _call_unary(self, dep: str, method: str, request: bytes, context,
+                    model_id: str) -> bytes:
+        import grpc
+
+        m = self._target(dep, method, context, model_id)
+        args, kwargs = _load_request(request)
+        try:
+            out = m.remote(*args, **kwargs).result(timeout=300)
+            return pickle.dumps(out)
+        except Exception as e:  # noqa: BLE001
+            context.abort(grpc.StatusCode.INTERNAL,
+                          f"{type(e).__name__}: {e}")
+
+    def _call_stream(self, dep: str, method: str, request: bytes, context,
+                     model_id: str):
+        import grpc
+
+        import ray_tpu
+
+        m = self._target(dep, method, context, model_id)
+        args, kwargs = _load_request(request)
+        try:
+            for ref in m.remote(*args, **kwargs):
+                yield pickle.dumps(ray_tpu.get(ref, timeout=300))
+        except Exception as e:  # noqa: BLE001
+            context.abort(grpc.StatusCode.INTERNAL,
+                          f"{type(e).__name__}: {e}")
+
+    def stop(self) -> None:
+        self._server.stop(grace=1.0)
+
+
+def start_grpc_proxy(host: str = "127.0.0.1", port: int = 9000) -> int:
+    """Start (or return) the node's gRPC ingress; returns the bound
+    port."""
+    global _PROXY
+    with _PROXY_LOCK:
+        if _PROXY is None:
+            _PROXY = _GrpcProxy(host, port)
+        return _PROXY.port
+
+
+def stop_grpc_proxy() -> None:
+    global _PROXY
+    with _PROXY_LOCK:
+        if _PROXY is not None:
+            _PROXY.stop()
+            _PROXY = None

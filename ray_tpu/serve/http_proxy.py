@@ -92,7 +92,8 @@ class _AsyncProxy:
                 if length:
                     body = await reader.readexactly(length)
                 keep_alive = headers.get("connection", "keep-alive").lower() != "close"
-                await self._dispatch(method, path, body, writer)
+                await self._dispatch(method, path, body, writer,
+                                     headers)
                 if not keep_alive:
                     return
         except (asyncio.IncompleteReadError, ConnectionError):
@@ -104,11 +105,16 @@ class _AsyncProxy:
                 pass
 
     async def _dispatch(self, method: str, path: str, body: bytes,
-                        writer: asyncio.StreamWriter) -> None:
+                        writer: asyncio.StreamWriter,
+                        headers: Dict[str, str] = None) -> None:
         name = path.strip("/").split("?")[0].split("/")[0]
         loop = asyncio.get_event_loop()
+        # reference: the HTTP proxy honors the multiplexed-model header
+        model_id = (headers or {}).get("serve_multiplexed_model_id", "")
         try:
             handle = await loop.run_in_executor(None, self._get_handle, name)
+            if model_id:
+                handle = handle.options(multiplexed_model_id=model_id)
             payload = json.loads(body) if body else None
             result = await loop.run_in_executor(
                 None, lambda: handle.remote(payload) if payload is not None

@@ -1,0 +1,192 @@
+"""Serve round-4 additions (VERDICT round 3 item 8): gRPC ingress
+through the same router as HTTP (reference: serve/_private/proxy.py:520),
+@serve.multiplexed LRU model multiplexing with cache-aware routing
+(reference: serve/multiplex.py:22), and local_testing_mode (reference:
+serve/_private/local_testing_mode.py)."""
+
+import pickle
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture()
+def serve_cluster(ray_start_regular):
+    yield
+    try:
+        serve.shutdown()
+    except Exception:
+        pass
+
+
+def _mux_model(num_replicas: int, name: str):
+    """Deployments are defined per-test: closures cloudpickle by value
+    into the replica workers (a module-level class would pickle by
+    reference into the unimportable test module)."""
+
+    @serve.deployment(name=name, num_replicas=num_replicas)
+    class MuxModel:
+        def __init__(self):
+            self.load_count = 0
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id: str):
+            self.load_count += 1
+            return {"id": model_id, "n": self.load_count}
+
+        def __call__(self, x):
+            mid = serve.get_multiplexed_model_id()
+            model = self.get_model(mid)
+            return {"model": model["id"], "x": x,
+                    "loads": self.load_count}
+
+        def loads(self):
+            return self.load_count
+
+    return MuxModel
+
+
+# ---------------------------------------------------------------------------
+# @serve.multiplexed
+# ---------------------------------------------------------------------------
+class TestMultiplexed:
+    def test_routing_is_cache_aware(self, serve_cluster):
+        handle = serve.run(_mux_model(2, "mux").bind(), name="mux")
+        # warm up: let both replicas finish starting and the handle's
+        # long-poll settle on the final replica set BEFORE measuring —
+        # a mid-test replica-set swap would reset the affinity map
+        handle.options(multiplexed_model_id="m0").remote(-1).result(
+            timeout=120)
+        import time as _t
+
+        _t.sleep(1.0)
+        # many calls across 3 model ids: affinity pins each model to ONE
+        # replica, so across the whole replica set each model loads
+        # exactly once — without cache-aware routing, pow-2 would
+        # scatter repeats across replicas and reload
+        outs = []
+        for i in range(12):
+            mid = f"m{i % 3}"
+            outs.append(handle.options(
+                multiplexed_model_id=mid).remote(i).result(timeout=120))
+        assert all(o["model"] == f"m{i % 3}" for i, o in enumerate(outs))
+        from ray_tpu.serve.controller import _controller
+
+        snap = ray_tpu.get(
+            _controller().get_deployment.remote("mux"), timeout=60)
+        per_replica = [
+            ray_tpu.get(a.handle_request.remote("loads", (), {}),
+                        timeout=60)
+            for a in snap["replicas"]]
+        # 3 distinct models, each pinned to one replica = 3 loads (4 if
+        # the warmup's affinity was reset by a replica-set settle);
+        # WITHOUT cache-aware routing pow-2 scatters repeats across both
+        # replicas, loading up to one copy per (model, replica) pair = 6
+        assert 3 <= sum(per_replica) <= 4, per_replica
+        serve.delete("mux")
+
+    def test_lru_eviction(self, serve_cluster):
+        handle = serve.run(_mux_model(1, "mux1").bind(), name="mux1")
+        # 3 distinct models through a 2-model LRU on ONE replica:
+        # m0, m1, m2 (evicts m0), then m0 again -> reload => 4 loads
+        for mid in ["m0", "m1", "m2", "m0"]:
+            handle.options(multiplexed_model_id=mid).remote(
+                0).result(timeout=120)
+        loads = handle.loads.remote().result(timeout=60)
+        assert loads == 4
+        # LRU is now [m2, m0]: m2 is a hit, no new load
+        out = handle.options(multiplexed_model_id="m2").remote(
+            1).result(timeout=120)
+        assert out["loads"] == 4
+        serve.delete("mux1")
+
+
+# ---------------------------------------------------------------------------
+# gRPC ingress
+# ---------------------------------------------------------------------------
+class TestGrpcIngress:
+    def test_unary_and_streaming(self, serve_cluster):
+        import grpc
+
+        @serve.deployment(name="echo_grpc")
+        class Echo:
+            def __call__(self, x):
+                return {"echo": x}
+
+            def tokens(self, n: int):
+                for i in range(n):
+                    yield f"t{i}"
+
+        serve.run(Echo.bind(), name="echo_grpc")
+        port = serve.start_grpc_proxy(port=0)
+        try:
+            ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+            call = ch.unary_unary("/echo_grpc/__call__")
+            out = pickle.loads(call(pickle.dumps((("hello",), {}))))
+            assert out == {"echo": "hello"}
+
+            stream = ch.unary_stream("/echo_grpc/tokens")
+            pieces = [pickle.loads(m)
+                      for m in stream(pickle.dumps(((3,), {})))]
+            assert pieces == ["t0", "t1", "t2"]
+
+            missing = ch.unary_unary("/NoSuchApp/__call__")
+            with pytest.raises(grpc.RpcError):
+                missing(pickle.dumps(((1,), {})))
+            ch.close()
+        finally:
+            serve.stop_grpc_proxy()
+            serve.delete("echo_grpc")
+
+    def test_multiplexed_metadata(self, serve_cluster):
+        import grpc
+
+        serve.run(_mux_model(1, "mux_grpc").bind(), name="mux_grpc")
+        port = serve.start_grpc_proxy(port=0)
+        try:
+            ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+            call = ch.unary_unary("/mux_grpc/__call__")
+            out = pickle.loads(call(
+                pickle.dumps(((5,), {})),
+                metadata=(("multiplexed_model_id", "mx"),)))
+            assert out["model"] == "mx"
+            ch.close()
+        finally:
+            serve.stop_grpc_proxy()
+            serve.delete("mux_grpc")
+
+
+# ---------------------------------------------------------------------------
+# local testing mode
+# ---------------------------------------------------------------------------
+class TestLocalTestingMode:
+    def test_no_cluster_needed(self):
+        # NOTE: no ray_start fixture — runs without any cluster
+        @serve.deployment
+        class Adder:
+            def __init__(self, base):
+                self.base = base
+
+            def __call__(self, x):
+                return self.base + x
+
+            def tokens(self, n):
+                for i in range(n):
+                    yield i
+
+        handle = serve.run(Adder.bind(10), local_testing_mode=True)
+        assert handle.remote(5).result(timeout=30) == 15
+        assert list(handle.tokens.remote(3)) == [0, 1, 2]
+
+    def test_multiplexed_locally(self):
+        handle = serve.run(_mux_model(1, "lmux").bind(),
+                           local_testing_mode=True)
+        out = handle.options(multiplexed_model_id="lm").remote(
+            1).result(timeout=30)
+        assert out["model"] == "lm"
+        # second call: cache hit
+        out2 = handle.options(multiplexed_model_id="lm").remote(
+            2).result(timeout=30)
+        assert out2["loads"] == 1
