@@ -134,6 +134,9 @@ class GcsServer:
         self._wal = None  # lazily-opened append handle
         self._wal_records = 0
         self._wal_degraded = False  # an append failed since last compact
+        self._wal_seq = 0  # records appended this process lifetime
+        self._wal_synced = 0  # highest seq durable (group fsync/snapshot)
+        self._wal_sync_lock: Optional[asyncio.Lock] = None  # loop-affine
         self._load_persisted()
         replayed, had_wal = self._replay_wal()
         if replayed:
@@ -150,6 +153,7 @@ class GcsServer:
             self._dirty = True
             self._compact()
         self.server.register_instance(self)
+        self.server.pre_response = self._wal_barrier
 
     # ------------------------------------------------------------------
     # persistence (file-backed snapshot of the durable tables: KV,
@@ -244,9 +248,12 @@ class GcsServer:
         return self._wal
 
     def _log(self, kind: str, *payload: Any) -> None:
-        """Append one durable mutation to the WAL (fsync'd): a crash at
-        ANY point after the ack replays the mutation on restart —
-        nothing acknowledged is ever lost between snapshots."""
+        """Append one durable mutation to the WAL. The append is flushed
+        to the OS but NOT fsync'd here: the RPC layer awaits
+        ``_wal_barrier`` before sending any response, so one group
+        fsync covers every record the current batch of handlers
+        appended — a crash at ANY point after an ack still replays the
+        mutation on restart, without a disk sync per mutation."""
         if not self.storage_path:
             return
         try:
@@ -255,7 +262,6 @@ class GcsServer:
             f.write(struct.pack("<I", len(rec)))
             f.write(rec)
             f.flush()
-            os.fsync(f.fileno())
         except Exception:
             logger.exception("WAL append failed")
             # the mutation is acknowledged but not on disk: mark for the
@@ -265,9 +271,41 @@ class GcsServer:
             self._dirty = True
             self._wal_degraded = True
             return
+        self._wal_seq += 1
         self._wal_records += 1
         if self._wal_records >= self._WAL_COMPACT_RECORDS:
             self._compact()
+
+    async def _wal_barrier(self) -> None:
+        """Group-commit fsync (the RpcServer ``pre_response`` hook):
+        make every WAL record appended so far durable before any
+        handler's ack leaves the process. Concurrent barriers coalesce
+        behind one lock — the first fsync covers the whole batch and
+        the rest return without touching the disk."""
+        if not self.storage_path or self._wal_synced >= self._wal_seq:
+            return
+        if self._wal_sync_lock is None:
+            self._wal_sync_lock = asyncio.Lock()
+        async with self._wal_sync_lock:
+            seq = self._wal_seq
+            if self._wal_synced >= seq:
+                return
+            f = self._wal
+            if f is None:
+                return  # compaction just truncated: state is in the snapshot
+            try:
+                fd = f.fileno()
+                await asyncio.get_event_loop().run_in_executor(
+                    None, os.fsync, fd)
+            except Exception:  # noqa: BLE001
+                if self._wal_synced >= seq:
+                    return  # compaction raced the fsync; snapshot has it
+                logger.exception("WAL group fsync failed")
+                self._dirty = True
+                self._wal_degraded = True
+                return
+            if self._wal_synced < seq:
+                self._wal_synced = seq
 
     def _compact(self) -> None:
         """Fold the WAL into a fresh snapshot and truncate it. Crash
@@ -279,6 +317,10 @@ class GcsServer:
             # snapshot failed (e.g. disk full): keep the WAL — truncating
             # would discard the only durable copy of acknowledged state
             return
+        # the fsync'd snapshot now holds every mutation applied so far;
+        # advance the group-commit cursor BEFORE closing the file so a
+        # barrier racing the close re-checks and finds itself covered
+        self._wal_synced = self._wal_seq
         try:
             if self._wal is not None:
                 self._wal.close()
@@ -347,6 +389,9 @@ class GcsServer:
         elif kind == "named":
             ns, name, aid = payload
             self.named_actors[(ns, name)] = aid
+        elif kind == "named_del":
+            ns, name = payload
+            self.named_actors.pop((ns, name), None)
         elif kind == "pg":
             pg = payload[0]
             self.placement_groups[pg.pg_id] = pg
@@ -1041,7 +1086,12 @@ class GcsServer:
             actor.death_cause = cause
             actor.version += 1
             if actor.name:
-                self.named_actors.pop((actor.namespace, actor.name), None)
+                # durable un-delete guard: without this record a crash
+                # before the next compaction would resurrect the
+                # name→DEAD-actor mapping on WAL replay
+                if self.named_actors.pop(
+                        (actor.namespace, actor.name), None) is not None:
+                    self._log("named_del", actor.namespace, actor.name)
             self._notify_actor(actor.actor_id)
         if worker_addr:
             try:

@@ -148,6 +148,13 @@ class RpcServer:
         self._server: Optional[asyncio.AbstractServer] = None
         self._loop_thread: Optional[EventLoopThread] = None
         self._handler_stats: Dict[str, Tuple[int, float]] = {}
+        # awaited after each handler, before its response frame is sent.
+        # The GCS hangs its WAL group-commit barrier here: handlers
+        # append durable records without fsync, and one fsync covers
+        # every record appended by the batch of handlers that completed
+        # this tick — durability-before-ack without a disk sync per
+        # mutation.
+        self.pre_response: Optional[Callable[[], Awaitable[None]]] = None
 
     def register(self, method: str, handler: Callable) -> None:
         self._handlers[method] = handler
@@ -243,6 +250,11 @@ class RpcServer:
         dt = time.monotonic() - t0
         if dt * 1000 > config.event_loop_slow_handler_ms:
             logger.warning("%s: slow handler %s took %.1fms", self.name, method, dt * 1000)
+        if self.pre_response is not None:
+            try:
+                await self.pre_response()
+            except Exception:  # noqa: BLE001
+                logger.exception("%s: pre_response hook failed", self.name)
         try:
             _write_frame(writer, call_id, KIND_RESPONSE, payload)
             await writer.drain()

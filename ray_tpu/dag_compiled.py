@@ -205,17 +205,30 @@ class CompiledDAGRef:
         self._dag = dag
         self._idx = idx
         self._consumed = False
+        self._err: Optional[BaseException] = None
 
     def get(self, timeout: Optional[float] = None):
         # once-only, like the reference: the channel value is consumed
         # by the first get — a second would silently read a LATER
-        # execution's output
+        # execution's output. An execution that raised is re-raised on
+        # every get (the channel slot is already consumed; looping on it
+        # would wait forever and steal later executions' outputs).
         if self._consumed:
+            if self._err is not None:
+                raise self._err
             raise ValueError(
                 "CompiledDAGRef.get() can only be called once")
-        value = self._dag._get_result(self._idx, timeout)
+        # a timeout below leaves the ref unconsumed: _fetch_result only
+        # pops the cache once every output channel has delivered
+        vals = self._dag._fetch_result(self._idx, timeout)
         self._consumed = True
-        return value
+        out = []
+        for v in vals:
+            if isinstance(v, _DagErr):
+                self._err = pickle.loads(v.data)
+                raise self._err
+            out.append(v)
+        return out if self._dag._multi else out[0]
 
 
 class CompiledDAG:
@@ -546,7 +559,10 @@ class CompiledDAG:
                         f"compiled DAG output not ready within "
                         f"{timeout}s") from None
 
-    def _get_result(self, idx: int, timeout: Optional[float]):
+    def _fetch_result(self, idx: int, timeout: Optional[float]):
+        """Pop execution ``idx``'s raw output list (``_DagErr`` entries
+        included — CompiledDAGRef.get unwraps them so it can record the
+        consumption before raising)."""
         with self._read_lock:
             while idx not in self._result_cache:
                 if self._torn_down:
@@ -562,13 +578,7 @@ class CompiledDAG:
                 vals, self._partial = self._partial, []
                 self._result_cache[self._read_cursor] = vals
                 self._read_cursor += 1
-            vals = self._result_cache.pop(idx)
-        out = []
-        for v in vals:
-            if isinstance(v, _DagErr):
-                raise pickle.loads(v.data)
-            out.append(v)
-        return out if self._multi else out[0]
+            return self._result_cache.pop(idx)
 
     def _execute_taskpath(self, input_value):
         """Fallback: per-execute task submission (pre-channel behavior)."""

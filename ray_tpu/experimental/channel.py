@@ -99,10 +99,10 @@ class ChannelReader(_Endpoint):
         self.reader_index = reader_index
         self._last = self._get(16 + 8 * reader_index)
 
-    def _await_next(self, timeout: Optional[float]) -> int:
+    def _await_next(self, deadline: Optional[float],
+                    timeout: Optional[float]) -> int:
         """Spin until a stable (even) sequence newer than the last-read
         one exists."""
-        deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             seq = self._seq
             if seq > self._last and seq % 2 == 0:
@@ -114,8 +114,12 @@ class ChannelReader(_Endpoint):
 
     def read(self, timeout: Optional[float] = 10.0) -> Any:
         """Block until the NEXT value is written; acknowledge it."""
+        # one deadline for the whole call: the seqlock retry loop must
+        # not restart the clock each time a concurrent write invalidates
+        # a copy, or the declared timeout stops being an upper bound
+        deadline = None if timeout is None else time.monotonic() + timeout
         while True:
-            seq = self._await_next(timeout)
+            seq = self._await_next(deadline, timeout)
             n = self._get(8)
             data = bytes(self._shm.buf[self._hdr: self._hdr + n])
             if self._seq == seq:  # seqlock re-check: no concurrent write
@@ -202,8 +206,9 @@ class TensorChannelReader(ChannelReader):
         reuses it immediately after the ack)."""
         import numpy as np
 
+        deadline = None if timeout is None else time.monotonic() + timeout
         while True:
-            seq = self._await_next(timeout)
+            seq = self._await_next(deadline, timeout)
             view = np.ndarray(self.shape, self.dtype,
                               buffer=self._shm.buf, offset=self._hdr)
             out = view.copy()

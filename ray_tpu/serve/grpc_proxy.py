@@ -2,22 +2,38 @@
 second protocol through the same router as HTTP).
 
 Generic service, no compiled .proto needed: the gRPC method path names
-the deployment and handler — ``/<deployment>/<method>`` — the request
-message is a pickled ``(args, kwargs)`` tuple (or raw bytes treated as
-a single positional argument), and the response is the pickled result.
+the deployment and handler — ``/<deployment>/<method>``. The wire
+payload is selected by the ``payload`` metadata key:
+
+- ``raw`` (default) — the request bytes become one positional argument;
+  the response is the result's bytes (``bytes`` pass through, ``str``
+  is utf-8 encoded, anything else is JSON-encoded). Safe for untrusted
+  callers.
+- ``json`` — the request is a JSON object ``{"args": [...],
+  "kwargs": {...}}`` (or a bare JSON array = args); the response is
+  JSON. Safe for untrusted callers.
+- ``pickle`` — the request is a pickled ``(args, kwargs)`` tuple and
+  the response is the pickled result. **pickle.loads on network input
+  is arbitrary code execution** (the reference gRPCProxy uses compiled
+  user protobufs instead, serve/_private/proxy.py:520), so this mode is
+  only accepted when the proxy is bound to loopback or started with
+  ``allow_pickle=True`` — never expose it beyond a trusted network.
+
 Generator handlers stream one message per yield. The metadata key
 ``multiplexed_model_id`` routes to a model-holding replica exactly like
 ``handle.options(multiplexed_model_id=...)``.
 
-Python client:
+Python client (trusted, loopback):
 
     ch = grpc.insecure_channel(f"127.0.0.1:{port}")
     call = ch.unary_unary("/my_app/__call__")
-    result = pickle.loads(call(pickle.dumps(((arg,), {}))))
+    result = pickle.loads(call(pickle.dumps(((arg,), {})),
+                               metadata=(("payload", "pickle"),)))
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import pickle
 import threading
@@ -29,21 +45,70 @@ logger = logging.getLogger("ray_tpu.serve.grpc")
 _PROXY_LOCK = threading.Lock()
 _PROXY: Optional["_GrpcProxy"] = None
 
+_LOOPBACK = ("127.0.0.1", "localhost", "::1")
 
-def _load_request(data: bytes):
-    try:
-        args, kwargs = pickle.loads(data)
-        if isinstance(args, tuple) and isinstance(kwargs, dict):
-            return args, kwargs
-    except Exception:  # noqa: BLE001
-        pass
-    return (data,), {}  # raw payload as one positional arg
+
+class _PayloadError(Exception):
+    pass
+
+
+def _load_request(data: bytes, mode: str, allow_pickle: bool):
+    if mode == "pickle":
+        if not allow_pickle:
+            raise _PayloadError(
+                "payload=pickle is disabled on this proxy (bound beyond "
+                "loopback without allow_pickle=True); use payload=json "
+                "or raw bytes")
+        try:
+            args, kwargs = pickle.loads(data)
+            if isinstance(args, tuple) and isinstance(kwargs, dict):
+                return args, kwargs
+        except Exception:  # noqa: BLE001
+            pass
+        return (data,), {}  # raw payload as one positional arg
+    if mode == "json":
+        try:
+            obj = json.loads(data.decode("utf-8"))
+        except Exception as e:  # noqa: BLE001
+            raise _PayloadError(f"invalid JSON request: {e}")
+        if isinstance(obj, dict) and ("args" in obj or "kwargs" in obj):
+            try:
+                return (tuple(obj.get("args", ())),
+                        dict(obj.get("kwargs", {})))
+            except (TypeError, ValueError) as e:
+                raise _PayloadError(
+                    f"json request 'args' must be a list and 'kwargs' "
+                    f"an object: {e}")
+        if isinstance(obj, list):
+            return tuple(obj), {}
+        return (obj,), {}
+    if mode == "raw":
+        return (data,), {}
+    raise _PayloadError(
+        f"unknown payload mode {mode!r}: expected raw, json, or pickle")
+
+
+def _dump_response(out, mode: str) -> bytes:
+    if mode == "pickle":
+        return pickle.dumps(out)
+    if mode == "json":
+        return json.dumps(out).encode("utf-8")
+    # raw: bytes pass through, str is utf-8, structures fall back to JSON
+    if isinstance(out, bytes):
+        return out
+    if isinstance(out, str):
+        return out.encode("utf-8")
+    return json.dumps(out).encode("utf-8")
 
 
 class _GrpcProxy:
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int,
+                 allow_pickle: Optional[bool] = None):
         import grpc
 
+        if allow_pickle is None:
+            allow_pickle = host in _LOOPBACK
+        self._allow_pickle = allow_pickle
         self._handles: Dict[str, Any] = {}
         self._hlock = threading.Lock()
 
@@ -57,14 +122,16 @@ class _GrpcProxy:
                 dep, method = parts
                 md = dict(handler_call_details.invocation_metadata or ())
                 model_id = md.get("multiplexed_model_id", "")
+                payload = md.get("payload", "raw")
 
                 def unary(request, context):
                     return proxy._call_unary(dep, method, request,
-                                             context, model_id)
+                                             context, model_id, payload)
 
                 def stream(request, context):
                     yield from proxy._call_stream(dep, method, request,
-                                                  context, model_id)
+                                                  context, model_id,
+                                                  payload)
 
                 if proxy._is_streaming(dep, method):
                     return grpc.unary_stream_rpc_method_handler(
@@ -111,29 +178,42 @@ class _GrpcProxy:
             else getattr(target, method)
 
     def _call_unary(self, dep: str, method: str, request: bytes, context,
-                    model_id: str) -> bytes:
+                    model_id: str, payload: str) -> bytes:
         import grpc
 
         m = self._target(dep, method, context, model_id)
-        args, kwargs = _load_request(request)
+        try:
+            args, kwargs = _load_request(request, payload,
+                                         self._allow_pickle)
+        except _PayloadError as e:
+            context.abort(grpc.StatusCode.PERMISSION_DENIED
+                          if "disabled" in str(e)
+                          else grpc.StatusCode.INVALID_ARGUMENT, str(e))
         try:
             out = m.remote(*args, **kwargs).result(timeout=300)
-            return pickle.dumps(out)
+            return _dump_response(out, payload)
         except Exception as e:  # noqa: BLE001
             context.abort(grpc.StatusCode.INTERNAL,
                           f"{type(e).__name__}: {e}")
 
     def _call_stream(self, dep: str, method: str, request: bytes, context,
-                     model_id: str):
+                     model_id: str, payload: str):
         import grpc
 
         import ray_tpu
 
         m = self._target(dep, method, context, model_id)
-        args, kwargs = _load_request(request)
+        try:
+            args, kwargs = _load_request(request, payload,
+                                         self._allow_pickle)
+        except _PayloadError as e:
+            context.abort(grpc.StatusCode.PERMISSION_DENIED
+                          if "disabled" in str(e)
+                          else grpc.StatusCode.INVALID_ARGUMENT, str(e))
         try:
             for ref in m.remote(*args, **kwargs):
-                yield pickle.dumps(ray_tpu.get(ref, timeout=300))
+                yield _dump_response(ray_tpu.get(ref, timeout=300),
+                                     payload)
         except Exception as e:  # noqa: BLE001
             context.abort(grpc.StatusCode.INTERNAL,
                           f"{type(e).__name__}: {e}")
@@ -142,13 +222,27 @@ class _GrpcProxy:
         self._server.stop(grace=1.0)
 
 
-def start_grpc_proxy(host: str = "127.0.0.1", port: int = 9000) -> int:
+def start_grpc_proxy(host: str = "127.0.0.1", port: int = 9000,
+                     allow_pickle: Optional[bool] = None) -> int:
     """Start (or return) the node's gRPC ingress; returns the bound
-    port."""
+    port.
+
+    ``allow_pickle`` gates the ``payload=pickle`` wire mode (arbitrary
+    code execution for whoever can reach the port). ``None`` (default)
+    enables it only when ``host`` is loopback; pass ``True`` explicitly
+    to accept pickle on a non-loopback bind — trusted networks only.
+    """
     global _PROXY
     with _PROXY_LOCK:
         if _PROXY is None:
-            _PROXY = _GrpcProxy(host, port)
+            _PROXY = _GrpcProxy(host, port, allow_pickle=allow_pickle)
+        elif (allow_pickle is not None
+              and allow_pickle != _PROXY._allow_pickle):
+            # the singleton must not silently ignore a security setting
+            raise ValueError(
+                f"gRPC proxy already running with allow_pickle="
+                f"{_PROXY._allow_pickle}; stop_grpc_proxy() first to "
+                f"change it")
         return _PROXY.port
 
 

@@ -103,8 +103,13 @@ def test_channel_dag_multi_output_and_errors(cluster):
         dag2 = w.ok.bind(w.boom.bind(inp))
     compiled2 = dag2.experimental_compile()
     try:
+        ref = compiled2.execute(1)
         with pytest.raises(Exception, match="dag boom"):
-            ray_tpu.get(compiled2.execute(1), timeout=60)
+            ref.get(timeout=60)
+        # a second get on an erroring ref re-raises — it must not hang
+        # waiting on the already-consumed channel slot (ADVICE r4)
+        with pytest.raises(Exception, match="dag boom"):
+            ref.get(timeout=5)
         # the loop survives a user exception: next execute still works...
         with pytest.raises(Exception, match="dag boom"):
             ray_tpu.get(compiled2.execute(2), timeout=60)

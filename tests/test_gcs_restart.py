@@ -143,3 +143,34 @@ def test_wal_no_lost_updates_on_immediate_kill(persistent_cluster):
     # instance (same process, state intact) serves calls
     h2 = ray_tpu.get_actor("walkv")
     assert ray_tpu.get(h2.put.remote("k2", 1), timeout=60) == "ok"
+
+
+def test_named_actor_kill_survives_replay(persistent_cluster):
+    """ADVICE r4: killing a named actor pops the name→actor mapping, and
+    the deletion itself must be durable — a crash right after the
+    acknowledged kill must not resurrect the name on WAL replay."""
+    cluster = persistent_cluster
+
+    @ray_tpu.remote
+    class Named:
+        def ping(self):
+            return "pong"
+
+    h = Named.options(name="doomed", lifetime="detached").remote()
+    assert ray_tpu.get(h.ping.remote(), timeout=60) == "pong"
+    ray_tpu.kill(h, no_restart=True)
+    # wait for the kill to be acknowledged in the GCS tables
+    gcs = RpcClient("127.0.0.1", cluster.gcs_port)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if gcs.call("GetActorByName", name="doomed", namespace="default",
+                    timeout=10) is None:
+            break
+        time.sleep(0.2)
+    cluster.kill_gcs()  # SIGKILL, zero settling time
+    cluster._start_gcs()
+    _wait_nodes_alive(cluster, 1)
+    assert gcs.call_retrying("GetActorByName", name="doomed",
+                             namespace="default", timeout=10) is None
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("doomed")

@@ -4,6 +4,7 @@ through the same router as HTTP (reference: serve/_private/proxy.py:520),
 (reference: serve/multiplex.py:22), and local_testing_mode (reference:
 serve/_private/local_testing_mode.py)."""
 
+import json
 import pickle
 
 import pytest
@@ -122,19 +123,28 @@ class TestGrpcIngress:
         serve.run(Echo.bind(), name="echo_grpc")
         port = serve.start_grpc_proxy(port=0)
         try:
+            pkl = (("payload", "pickle"),)
             ch = grpc.insecure_channel(f"127.0.0.1:{port}")
             call = ch.unary_unary("/echo_grpc/__call__")
-            out = pickle.loads(call(pickle.dumps((("hello",), {}))))
+            out = pickle.loads(call(pickle.dumps((("hello",), {})),
+                                    metadata=pkl))
             assert out == {"echo": "hello"}
+
+            # json payload mode: safe for untrusted callers
+            out = json.loads(call(
+                json.dumps({"args": ["hi"]}).encode(),
+                metadata=(("payload", "json"),)))
+            assert out == {"echo": "hi"}
 
             stream = ch.unary_stream("/echo_grpc/tokens")
             pieces = [pickle.loads(m)
-                      for m in stream(pickle.dumps(((3,), {})))]
+                      for m in stream(pickle.dumps(((3,), {})),
+                                      metadata=pkl)]
             assert pieces == ["t0", "t1", "t2"]
 
             missing = ch.unary_unary("/NoSuchApp/__call__")
             with pytest.raises(grpc.RpcError):
-                missing(pickle.dumps(((1,), {})))
+                missing(pickle.dumps(((1,), {})), metadata=pkl)
             ch.close()
         finally:
             serve.stop_grpc_proxy()
@@ -150,7 +160,8 @@ class TestGrpcIngress:
             call = ch.unary_unary("/mux_grpc/__call__")
             out = pickle.loads(call(
                 pickle.dumps(((5,), {})),
-                metadata=(("multiplexed_model_id", "mx"),)))
+                metadata=(("multiplexed_model_id", "mx"),
+                          ("payload", "pickle"))))
             assert out["model"] == "mx"
             ch.close()
         finally:
