@@ -17,16 +17,21 @@ import psutil
 
 
 def list_worker_pids(raylet_pid: int) -> List[int]:
-    """PIDs of worker processes owned by a raylet."""
+    """PIDs of worker processes owned by a raylet. Covers both spawn
+    paths: cold-started workers (``default_worker`` in the cmdline) and
+    zygote-forked workers (which inherit the zygote's cmdline — their
+    kernel comm is stamped ``rtw:<id>``, and the zygote parent itself
+    must NOT be a kill candidate)."""
     out = []
     try:
         parent = psutil.Process(raylet_pid)
         for child in parent.children(recursive=True):
             try:
                 cmd = " ".join(child.cmdline())
+                comm = child.name()
             except psutil.Error:
                 continue
-            if "default_worker" in cmd:
+            if "default_worker" in cmd or comm.startswith("rtw:"):
                 out.append(child.pid)
     except psutil.Error:
         pass

@@ -47,12 +47,22 @@ _DEFINITIONS: Dict[str, Tuple[type, Any]] = {
     "raylet_heartbeat_period_ms": (int, 500),
     "worker_lease_timeout_ms": (int, 30000),
     "worker_pool_prestart_workers": (bool, False),
+    # fork workers from a warmed zygote process instead of cold
+    # interpreter starts (workers/zygote.py)
+    "worker_zygote_enabled": (bool, True),
     "worker_idle_timeout_s": (float, 60.0),
     "max_workers_per_node": (int, 64),
     "scheduler_top_k_fraction": (float, 0.2),
     "scheduler_top_k_absolute": (int, 1),
     "scheduler_spread_threshold": (float, 0.5),
     "worker_startup_timeout_s": (float, 60.0),
+    # OOM worker killing (reference: raylet memory monitor +
+    # worker_killing_policy_group_by_owner.h); >= 1.0 disables
+    "memory_usage_threshold": (float, 0.97),
+    "memory_monitor_period_s": (float, 1.0),
+    # test hook: read the fake memory pct from this file instead of
+    # psutil (lets tests drive pressure up and down deterministically)
+    "testing_memory_pct_file": (str, ""),
     # --- object store ---
     "object_store_memory_bytes": (int, 2 * 1024**3),
     "object_store_socket": (str, ""),
@@ -62,6 +72,9 @@ _DEFINITIONS: Dict[str, Tuple[type, Any]] = {
     "object_pull_chunk_bytes": (int, 8 * 1024**2),
     # --- tasks ---
     "task_max_retries_default": (int, 3),
+    # queued same-class tasks pushed to a leased worker per RPC roundtrip
+    # (1 = the reference's one-PushTask-per-task behavior)
+    "task_push_batch_size": (int, 32),
     # producer pauses when this many yields sit unconsumed at the caller
     # (reference: generator_backpressure_num_objects)
     "streaming_generator_buffer_size": (int, 256),
@@ -70,6 +83,17 @@ _DEFINITIONS: Dict[str, Tuple[type, Any]] = {
     # how long a caller keeps resending an un-acked actor task while the
     # actor is unreachable/restarting before failing it
     "actor_task_resend_timeout_s": (float, 60.0),
+    # how long a caller waits for a PENDING actor to come ALIVE before
+    # its queued task fails (actor __init__ can be slow; large actor
+    # bursts queue behind each other)
+    "actor_wait_alive_timeout_s": (float, 180.0),
+    # GCS-side deadline for finding+leasing a worker for a PENDING actor
+    # (the whole creation backlog of a large burst queues behind it)
+    "actor_schedule_timeout_s": (float, 300.0),
+    # in-flight actor creations (lease+spawn+CreateActor pipelines) the
+    # GCS runs concurrently — admission control against thundering-herd
+    # collapse on hosts with few cores
+    "actor_creation_concurrency": (int, 48),
     # owner-side sweep dropping borrowers whose process died without
     # deregistering (reference: WaitForRefRemoved, reference_counter.h:44)
     "borrower_liveness_period_s": (float, 30.0),

@@ -308,59 +308,80 @@ class _ActorDispatcher:
 
     # -- watcher (io loop): pushed actor state + lost-result recovery ---
     async def _watch(self) -> None:
-        """One long-poll loop per dispatcher: the GCS pushes actor state
-        changes to it (reference: actor state pubsub channel). Costs one
-        GCS round-trip per ``timeout_s`` when nothing changes — constant,
-        independent of call rate."""
-        version = -1
-        while not (self._closed or self._dead or self.core._shutdown):
-            try:
-                info = await self.core.gcs.acall(
-                    "WaitActorUpdate", actor_id=self.aid,
-                    from_version=version, timeout_s=5.0, timeout=15,
-                )
-            except asyncio.CancelledError:
-                raise
-            except Exception:  # noqa: BLE001 — GCS blip; retry
-                await asyncio.sleep(1.0)
-                continue
-            with self.core._actor_pending_lock:
-                mine = {
-                    t: i
-                    for t, i in self.core._pending_actor_tasks.items()
-                    if i["aid"] == self.aid
-                }
-            if info is None or info["state"] == "DEAD":
-                cause = (info or {}).get(
-                    "death_cause", "actor no longer exists")
+        """Wakes on THIS actor's state changes via the process-wide
+        actor-state hub — one shared GCS ``Subscribe`` long-poll serves
+        every dispatcher in the process (the per-actor WaitActorUpdate
+        design cost N/5 RPC/s with N actors pending; a 2,000-actor burst
+        saturated the control plane on polls alone). GetActorInfo runs
+        only when the hub reports a change; the lost-push requery sweep
+        runs off the local clock with the cached address."""
+        ev = self.core._actor_hub.watch(self.aid)
+        try:
+            # one unconditional fetch: a state change BEFORE the hub
+            # registration must not be missed
+            changed = True
+            while not (self._closed or self._dead or self.core._shutdown):
+                with self.core._actor_pending_lock:
+                    mine = {
+                        t: i
+                        for t, i in self.core._pending_actor_tasks.items()
+                        if i["aid"] == self.aid
+                    }
+                current = None
+                if changed:
+                    try:
+                        info = await self.core.gcs.acall(
+                            "GetActorInfo", actor_id=self.aid, timeout=15)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:  # noqa: BLE001 — GCS blip; retry
+                        await asyncio.sleep(1.0)
+                        continue
+                    if info is None or info["state"] == "DEAD":
+                        cause = (info or {}).get(
+                            "death_cause", "actor no longer exists")
+                        for t, i in mine.items():
+                            self.core._fail_actor_task(
+                                t, i["return_oids"],
+                                ActorDiedError(
+                                    f"Actor {self.aid[:12]} died: "
+                                    f"{cause}"))
+                        self._dead = True
+                        self._retire([])
+                        return
+                    current = tuple(info["worker_addr"]) \
+                        if info.get("worker_addr") else None
+                else:
+                    cached = self.core._actor_addr_cache.get(self.aid)
+                    current = cached[0] if cached else None
+                now = time.monotonic()
                 for t, i in mine.items():
-                    self.core._fail_actor_task(
-                        t, i["return_oids"],
-                        ActorDiedError(
-                            f"Actor {self.aid[:12]} died: {cause}"))
-                self._dead = True
-                self._retire([])
-                return
-            version = info["version"]
-            current = tuple(info["worker_addr"]) \
-                if info.get("worker_addr") else None
-            now = time.monotonic()
-            for t, i in mine.items():
-                # enqueued on an incarnation that is gone → task was lost
-                if i["addr"] != current:
-                    self.core._fail_actor_task(
-                        t, i["return_oids"],
-                        RayActorError(
-                            f"Actor {self.aid[:12]} restarted; task "
-                            f"{t.hex()[:12]} was lost"))
-                elif now - i.get("ts", now) > self._REQUERY_AGE_S:
-                    # healthy actor, old pending task: the result push may
-                    # have been lost — ask the worker directly
-                    await self._requery(t, i, current)
-            if not mine and not self._has_pending():
-                # idle: stop long-polling the GCS (40k idle actors must
-                # not cost 8k RPC/s); _run re-arms us at the next send
-                return
+                    # enqueued on an incarnation that is gone → lost
+                    if changed and i["addr"] != current:
+                        self.core._fail_actor_task(
+                            t, i["return_oids"],
+                            RayActorError(
+                                f"Actor {self.aid[:12]} restarted; task "
+                                f"{t.hex()[:12]} was lost"))
+                    elif current is not None and i["addr"] == current \
+                            and now - i.get("ts", now) > self._REQUERY_AGE_S:
+                        # healthy actor, old pending task: the result
+                        # push may have been lost — ask the worker
+                        await self._requery(t, i, current)
+                if not mine and not self._has_pending():
+                    # idle: deregister from the hub (40k idle actors must
+                    # cost zero RPC); _run re-arms us at the next send
+                    return
+                changed = False
+                try:
+                    await asyncio.wait_for(ev.wait(),
+                                           timeout=self._REQUERY_AGE_S)
+                    changed = True
+                    ev.clear()
+                except asyncio.TimeoutError:
+                    pass
+        finally:
+            self.core._actor_hub.unwatch(self.aid, ev)
 
     async def _requery(
         self, tid: TaskID, info: dict, addr: Tuple[str, int],
@@ -392,6 +413,59 @@ class _ActorDispatcher:
                     f"{tid.hex()[:12]}; it was lost"),
             )
         # "running": leave it pending
+
+
+class _ActorStateHub:
+    """Process-wide fan-out of GCS actor-state events (reference:
+    src/ray/pubsub — every subscriber shares the publisher's channel;
+    the reference never opens one poll per actor, and at 2k+ actors
+    neither can we). One ``Subscribe("actor_state")`` long-poll feeds
+    per-actor asyncio.Events; the loop runs only while someone is
+    watching and dies when the last watcher leaves."""
+
+    def __init__(self, core: "CoreWorker"):
+        self.core = core
+        self._events: Dict[str, set] = {}  # aid -> set of Events
+        self._seq = 0
+        self._task: Optional[asyncio.Task] = None
+
+    def watch(self, aid: str) -> asyncio.Event:
+        """io-loop only. Returns an Event set on every state change of
+        ``aid`` (coalesced; consumer clears)."""
+        ev = asyncio.Event()
+        self._events.setdefault(aid, set()).add(ev)
+        if self._task is None or self._task.done():
+            self._task = asyncio.ensure_future(self._loop())
+        return ev
+
+    def unwatch(self, aid: str, ev: asyncio.Event) -> None:
+        s = self._events.get(aid)
+        if s is not None:
+            s.discard(ev)
+            if not s:
+                del self._events[aid]
+
+    async def _loop(self) -> None:
+        while self._events and not self.core._shutdown:
+            try:
+                rep = await self.core.gcs.acall(
+                    "Subscribe", channel="actor_state",
+                    after_seq=self._seq, timeout_s=30.0, timeout=45)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — GCS blip/restart
+                await asyncio.sleep(1.0)
+                # a restarted GCS renumbers its pubsub sequence; resync
+                # and wake everyone so they re-fetch their actor's state
+                self._seq = 0
+                for s in self._events.values():
+                    for ev in s:
+                        ev.set()
+                continue
+            self._seq = rep.get("next_seq", self._seq)
+            for _seqno, aid, _payload in rep.get("events", ()):
+                for ev in self._events.get(aid, ()):
+                    ev.set()
 
 
 class CoreWorker(CoreRuntime):
@@ -438,6 +512,7 @@ class CoreWorker(CoreRuntime):
         self.server.register("RemoveBorrower", self._handle_remove_borrower)
         self.server.register("ActorTaskDone", self._handle_actor_task_done)
         self.server.register("ActorTasksDone", self._handle_actor_tasks_done)
+        self.server.register("NormalTaskDone", self._handle_normal_task_done)
         self.server.register("StreamingYield", self._handle_streaming_yield)
         self.server.register("StreamingDone", self._handle_streaming_done)
         self.server.register("StreamingCredit", self._handle_streaming_credit)
@@ -453,8 +528,11 @@ class CoreWorker(CoreRuntime):
         self._lock = threading.Lock()
         self._leases: Dict[Any, List[_LeaseEntry]] = {}  # scheduling_class -> entries
         self._lease_requests_inflight: Dict[Any, int] = {}
-        self._task_queue: Dict[Any, List[TaskSpec]] = {}
+        # deques: 100k queued tasks must pop O(1), not O(n)
+        self._task_queue: Dict[Any, Any] = {}  # sc -> deque[TaskSpec]
         self._pending_tasks: Dict[TaskID, Dict[str, Any]] = {}
+        # worker_addr -> function_keys whose bytes that worker has cached
+        self._fns_shipped: Dict[Tuple[str, int], set] = {}
 
         # streaming generators: task_id -> _StreamState (task_manager.cc:778)
         self._streams: Dict[TaskID, Any] = {}
@@ -469,6 +547,7 @@ class CoreWorker(CoreRuntime):
         self._recovery_inflight: Dict[TaskID, threading.Event] = {}
         # actor state
         self._actor_addr_cache: Dict[str, Tuple[Tuple[str, int], int]] = {}  # id -> (addr, version)
+        self._actor_hub = _ActorStateHub(self)
         self._actor_dispatchers: Dict[str, _ActorDispatcher] = {}
         self._actor_disp_lock = threading.Lock()
         self._pending_actor_tasks: Dict[TaskID, Dict[str, Any]] = {}
@@ -1329,6 +1408,20 @@ class CoreWorker(CoreRuntime):
         ser_args, ser_kwargs, contained = self._serialize_args(args, kwargs)
         from ray_tpu._private.serialization import dumps_function
 
+        # pickle the function ONCE per RemoteFunction (reference exports
+        # once to the GCS function table); per-submit cloudpickle was the
+        # dominant driver-side cost for small tasks. The key is the
+        # content hash of the BYTES (not the source): closures from one
+        # factory share source but not cell values.
+        fn_bytes = getattr(remote_function, "_pickled_function", None)
+        if fn_bytes is None:
+            import hashlib
+
+            fn_bytes = dumps_function(remote_function._function)
+            remote_function._pickled_function = fn_bytes
+            remote_function._pickled_fn_key = hashlib.sha1(
+                fn_bytes).hexdigest()
+
         spec = TaskSpec(
             task_id=task_id,
             job_id=self.job_id,
@@ -1342,7 +1435,8 @@ class CoreWorker(CoreRuntime):
             max_retries=0 if streaming else opts.max_retries,
             retry_exceptions=opts.retry_exceptions,
             caller_addr=self.address,
-            serialized_function=dumps_function(remote_function._function),
+            serialized_function=fn_bytes,
+            function_key=remote_function._pickled_fn_key,
             # prepared HERE on the user thread: packaging uploads block on
             # GCS RPCs, which must never run on the io loop (_pack_spec
             # executes there during the push)
@@ -1378,10 +1472,12 @@ class CoreWorker(CoreRuntime):
                     lease = entry
                     break
         if lease is None:
-            self._task_queue.setdefault(sc, []).append(spec)
+            from collections import deque
+
+            self._task_queue.setdefault(sc, deque()).append(spec)
             await self._maybe_request_lease(sc, spec)
             return
-        await self._push_task(spec, lease)
+        await self._push_tasks([spec], lease)
 
     # -- scheduling strategies (reference: scheduling policies under
     # src/ray/raylet/scheduling/policy/ — node-affinity, spread, labels;
@@ -1582,17 +1678,55 @@ class CoreWorker(CoreRuntime):
             self._release_task_refs(s)
             self._pending_tasks.pop(s.task_id, None)
 
+    @staticmethod
+    def _batchable(spec: TaskSpec) -> bool:
+        """A spec may share a PushTaskBatch only if it carries NO
+        ObjectRef arguments. Batch replies arrive all-at-once, so a task
+        whose arg references a sibling earlier in the SAME batch would
+        block in the worker fetching a value whose reply is still
+        waiting behind the batch — a deadlock until timeout. Ref-arg
+        tasks go solo; queue FIFO then guarantees their dependencies
+        were pushed in an earlier roundtrip."""
+        if spec.is_streaming_generator:
+            return False  # delivers out-of-band; keep the RPC solo
+        if getattr(spec, "contained_refs", None):
+            return False  # refs nested inside arg structures
+        for a in spec.args:
+            if a.is_ref:
+                return False
+        for a in getattr(spec, "kwargs_map", {}).values():
+            if a.is_ref:
+                return False
+        return True
+
     async def _on_lease_idle(self, sc, entry: _LeaseEntry) -> None:
-        """Reuse the leased worker for the next queued task, or return it."""
+        """Reuse the leased worker for queued tasks, or return it. Pops
+        a batch of batchable specs for one PushTaskBatch roundtrip —
+        with a deep queue the per-task RPC roundtrip (not execution)
+        dominates small-task throughput. The batch size adapts to the
+        class's parallelism: popping 32 tasks onto one worker while 7
+        other leases sit idle would serialize work the old path ran in
+        parallel, so a shallow queue splits across the known workers."""
+        specs: List[TaskSpec] = []
         with self._lock:
-            queue = self._task_queue.get(sc, [])
-            spec = queue.pop(0) if queue else None
-            if spec is not None:
+            queue = self._task_queue.get(sc)
+            if queue:
+                n_workers = (len(self._leases.get(sc, []))
+                             + self._lease_requests_inflight.get(sc, 0))
+                cap = min(max(1, config.task_push_batch_size),
+                          max(1, len(queue) // max(1, n_workers)))
+                while queue and len(specs) < cap:
+                    if specs and not self._batchable(queue[0]):
+                        break  # non-batchable spec starts its own push
+                    s = queue.popleft()
+                    specs.append(s)
+                    if not self._batchable(s):
+                        break
                 entry.busy = True
-        if spec is None:
+        if not specs:
             await self._return_lease(sc, entry)
             return
-        await self._push_task(spec, entry)
+        await self._push_tasks(specs, entry)
 
     async def _return_lease(self, sc, entry: _LeaseEntry) -> None:
         with self._lock:
@@ -1610,48 +1744,119 @@ class CoreWorker(CoreRuntime):
             return self.raylet
         return get_client(tuple(entry.raylet_addr))
 
-    async def _push_task(self, spec: TaskSpec, entry: _LeaseEntry) -> None:
-        st = self._pending_tasks.get(spec.task_id)
-        if st is not None:
-            st["entry"] = entry  # cancel() needs the executing worker
-            # Check AFTER assigning entry: a cancel() that ran earlier (or
-            # concurrently — it sets cancelled before reading entry) is
-            # seen here, so either we skip dispatch or cancel() sends the
-            # CancelTask RPC; the race has no lost interleaving.
-            if st.get("cancelled"):
-                # don't dispatch; returns already poisoned
+    async def _push_tasks(self, specs: List[TaskSpec],
+                          entry: _LeaseEntry) -> None:
+        sc = specs[0].scheduling_class
+        live: List[TaskSpec] = []
+        for spec in specs:
+            st = self._pending_tasks.get(spec.task_id)
+            if st is not None:
+                st["entry"] = entry  # cancel() needs the executing worker
+                # Check AFTER assigning entry: a cancel() that ran earlier
+                # (or concurrently — it sets cancelled before reading
+                # entry) is seen here, so either we skip dispatch or
+                # cancel() sends the CancelTask RPC; the race has no lost
+                # interleaving.
+                if st.get("cancelled"):
+                    # don't dispatch; returns already poisoned
+                    self._release_task_refs(spec)
+                    self._pending_tasks.pop(spec.task_id, None)
+                    continue
+            live.append(spec)
+        if not live:
+            entry.busy = False
+            await self._on_lease_idle(sc, entry)
+            return
+        client = get_client(entry.worker_addr)
+        shipped = self._fns_shipped.setdefault(tuple(entry.worker_addr),
+                                               set())
+        payloads = []
+        in_batch: set = set()
+        for spec in live:
+            p = self._pack_spec(spec)
+            if spec.function_key and (spec.function_key in shipped
+                                      or spec.function_key in in_batch):
+                # bytes already live in that worker's key cache — or an
+                # earlier member of THIS batch carries them (the worker
+                # executes in order and caches before reaching us) —
+                # ship the hash only (the worker answers need_function
+                # on a cache miss and we resend with bytes below)
+                p["serialized_function"] = None
+            elif spec.function_key:
+                in_batch.add(spec.function_key)
+            payloads.append(p)
+        try:
+            if len(payloads) == 1:
+                replies = [await client.acall(
+                    "PushTask", spec_payload=payloads[0],
+                    timeout=-1,  # tasks can run arbitrarily long
+                )]
+            else:
+                replies = (await client.acall(
+                    "PushTaskBatch", spec_payloads=payloads,
+                    timeout=-1))["replies"]
+        except RemoteError as e:
+            # worker is alive but the push itself failed (e.g. payload
+            # could not be decoded) — a task error, NOT a worker death
+            err_by_name = {}
+            for spec in live:
+                st = self._pending_tasks.get(spec.task_id)
+                if st is None or st.get("completed_attempt") == spec.attempt_number:
+                    continue  # completed via NormalTaskDone before the raise
+                name = spec.function_descriptor.repr_name
+                if name not in err_by_name:
+                    err_by_name[name] = serialize(
+                        RayTaskError(name, str(e)))
+                data = err_by_name[name]
+                for oid in spec.return_ids():
+                    self.memory_store.put(oid, ("inline", data))
                 self._release_task_refs(spec)
                 self._pending_tasks.pop(spec.task_id, None)
-                entry.busy = False
-                await self._on_lease_idle(spec.scheduling_class, entry)
-                return
-        client = get_client(entry.worker_addr)
-        try:
-            reply = await client.acall(
-                "PushTask",
-                spec_payload=self._pack_spec(spec),
-                timeout=-1,  # tasks can run arbitrarily long
-            )
-        except RemoteError as e:
-            # worker is alive but the push itself failed (e.g. function
-            # could not be loaded) — a task error, NOT a worker death
-            err = RayTaskError(spec.function_descriptor.repr_name, str(e))
-            data = serialize(err)
-            for oid in spec.return_ids():
-                self.memory_store.put(oid, ("inline", data))
-            self._release_task_refs(spec)
-            self._pending_tasks.pop(spec.task_id, None)
             entry.busy = False
-            await self._on_lease_idle(spec.scheduling_class, entry)
+            await self._on_lease_idle(sc, entry)
             return
         except Exception as e:  # noqa: BLE001
-            logger.warning("push task %s failed: %s", spec.task_id.hex()[:12], e)
-            await self._handle_worker_failure(spec, entry, e)
+            logger.warning("push of %d task(s) failed: %s", len(live), e)
+            await self._handle_worker_failure(live, entry, e)
             return
-        self._complete_task(spec, reply)
+        batched = len(payloads) > 1
+        retry_with_bytes: List[TaskSpec] = []
+        for spec, reply in zip(live, replies):
+            if reply.get("need_function"):
+                shipped.discard(spec.function_key)
+                retry_with_bytes.append(spec)
+                continue
+            if spec.function_key:
+                shipped.add(spec.function_key)
+            if batched:
+                # batch members were (probably) already completed by the
+                # worker's out-of-band NormalTaskDone push — this reply
+                # is the fallback for a lost push; claim exactly once
+                if self._claim_push_completion(spec.task_id,
+                                               spec.attempt_number):
+                    self._complete_task(spec, reply)
+            else:
+                self._complete_task(spec, reply)
+        for pos, spec in enumerate(retry_with_bytes):
+            # worker evicted the function from its key cache: one more
+            # roundtrip with the bytes attached
+            try:
+                reply = await client.acall(
+                    "PushTask", spec_payload=self._pack_spec(spec),
+                    timeout=-1)
+            except Exception as e:  # noqa: BLE001
+                # EVERY not-yet-pushed retry spec fails/retries with
+                # this one — dropping them would leave their returns
+                # unresolved forever
+                await self._handle_worker_failure(
+                    retry_with_bytes[pos:], entry, e)
+                return
+            if spec.function_key:
+                shipped.add(spec.function_key)
+            self._complete_task(spec, reply)
         entry.busy = False
         entry.last_used = time.monotonic()
-        await self._on_lease_idle(spec.scheduling_class, entry)
+        await self._on_lease_idle(sc, entry)
 
     def _driver_py_paths(self) -> List[str]:
         """sys.path entries to replicate on workers so cloudpickle
@@ -1713,8 +1918,45 @@ class CoreWorker(CoreRuntime):
             "attempt_number": spec.attempt_number,
         }
 
-    async def _handle_worker_failure(self, spec: TaskSpec, entry: _LeaseEntry, error: Exception) -> None:
-        sc = spec.scheduling_class
+    def _claim_push_completion(self, task_id: TaskID,
+                               attempt_number: int) -> bool:
+        """Exactly-once gate between a batch task's out-of-band
+        NormalTaskDone push and the fallback reply in the PushTaskBatch
+        return: whichever arrives first completes the task, the other
+        is dropped. Keyed by attempt so a stale push from a pre-retry
+        attempt cannot complete the retried one."""
+        with self._lock:
+            st = self._pending_tasks.get(task_id)
+            if st is None:
+                return False  # completed-and-popped, or cancelled+reaped
+            if st["spec"].attempt_number != attempt_number:
+                return False
+            if st.get("completed_attempt") == attempt_number:
+                return False
+            st["completed_attempt"] = attempt_number
+            return True
+
+    def _handle_normal_task_done(self, task_id_bin: bytes,
+                                 attempt_number: int, reply: dict) -> dict:
+        """A leased worker finished one member of a PushTaskBatch —
+        deliver its result now, not when the whole batch returns (a
+        fast task must be visible to ray.wait while a slow batch
+        sibling still runs)."""
+        task_id = TaskID(bytes(task_id_bin))
+        with self._lock:
+            st = self._pending_tasks.get(task_id)
+            spec = st["spec"] if st is not None else None
+        if spec is None:
+            return {"ok": False}
+        if not self._claim_push_completion(task_id, attempt_number):
+            return {"ok": False}
+        self._complete_task(spec, reply)
+        return {"ok": True}
+
+    async def _handle_worker_failure(self, specs: List[TaskSpec],
+                                     entry: _LeaseEntry,
+                                     error: Exception) -> None:
+        sc = specs[0].scheduling_class
         with self._lock:
             entries = self._leases.get(sc, [])
             if entry in entries:
@@ -1725,28 +1967,37 @@ class CoreWorker(CoreRuntime):
             )
         except Exception:
             pass
-        st = self._pending_tasks.get(spec.task_id)
-        if st is not None and st["retries_left"] > 0 and not st.get("cancelled"):
-            st["retries_left"] -= 1
-            spec.attempt_number += 1
-            logger.info("retrying task %s (%d left)", spec.task_id.hex()[:12], st["retries_left"])
-            await self._submit_spec(spec)
-        else:
-            err = RayTaskError(
-                spec.function_descriptor.repr_name,
-                f"Worker died while running the task: {error}",
-                WorkerCrashedError(str(error)),
-            )
-            if spec.is_streaming_generator:
-                self._fail_stream(spec.task_id, err.as_instanceof_cause())
-            data = serialize(err)
-            for oid in spec.return_ids():
-                self.memory_store.put(oid, ("inline", data))
-            self._release_task_refs(spec)
-            st0 = self._pending_tasks.pop(spec.task_id, None)
-            if not (st0 or {}).get("cancelled"):
-                self._record_task_event(
-                    spec.task_id, spec.function_descriptor.repr_name, "FAILED")
+        # the worker is gone: its function cache went with it
+        self._fns_shipped.pop(tuple(entry.worker_addr), None)
+        for spec in specs:
+            st = self._pending_tasks.get(spec.task_id)
+            if st is None or st.get("completed_attempt") == spec.attempt_number:
+                # this batch member already completed through its
+                # out-of-band NormalTaskDone push before the worker (or
+                # the connection) died — failing it now would overwrite
+                # a delivered result with WorkerCrashedError
+                continue
+            if st is not None and st["retries_left"] > 0 and not st.get("cancelled"):
+                st["retries_left"] -= 1
+                spec.attempt_number += 1
+                logger.info("retrying task %s (%d left)", spec.task_id.hex()[:12], st["retries_left"])
+                await self._submit_spec(spec)
+            else:
+                err = RayTaskError(
+                    spec.function_descriptor.repr_name,
+                    f"Worker died while running the task: {error}",
+                    WorkerCrashedError(str(error)),
+                )
+                if spec.is_streaming_generator:
+                    self._fail_stream(spec.task_id, err.as_instanceof_cause())
+                data = serialize(err)
+                for oid in spec.return_ids():
+                    self.memory_store.put(oid, ("inline", data))
+                self._release_task_refs(spec)
+                st0 = self._pending_tasks.pop(spec.task_id, None)
+                if not (st0 or {}).get("cancelled"):
+                    self._record_task_event(
+                        spec.task_id, spec.function_descriptor.repr_name, "FAILED")
 
     def _complete_task(self, spec: TaskSpec, reply: dict) -> None:
         if spec.is_streaming_generator:
@@ -2019,39 +2270,57 @@ class CoreWorker(CoreRuntime):
         return ActorID.from_hex(reply["actor_id"])
 
     async def _resolve_actor_async(
-        self, actor_id_hex: str, wait_alive_s: float = 180.0,
+        self, actor_id_hex: str, wait_alive_s: Optional[float] = None,
     ) -> Tuple[str, int]:
         """Resolve an actor's worker address via the GCS long-poll,
         awaited on the io loop (blocking gcs.call there would deadlock
         the loop against its own replies). 180s default: actor __init__
         may legitimately cold-import jax and build a model inside a
-        fresh worker process."""
+        fresh worker process; raise ``actor_wait_alive_timeout_s`` for
+        thousand-actor bursts where the tail actor's creation backlog
+        exceeds it."""
+        if wait_alive_s is None:
+            wait_alive_s = config.actor_wait_alive_timeout_s
         deadline = time.monotonic() + wait_alive_s
         cached = self._actor_addr_cache.get(actor_id_hex)
         if cached is not None:
             return cached[0]
-        version = -1
-        while time.monotonic() < deadline:
-            try:
-                info = await self.gcs.acall(
-                    "WaitActorUpdate", actor_id=actor_id_hex,
-                    from_version=version, timeout_s=5.0, timeout=15)
-            except (RpcConnectionError, ConnectionError, OSError,
-                    TimeoutError):
-                await asyncio.sleep(0.5)
-                continue
-            if info is None:
-                raise ActorDiedError(
-                    f"Actor {actor_id_hex[:12]} does not exist")
-            version = info["version"]
-            if info["state"] == "ALIVE" and info["worker_addr"]:
-                addr = tuple(info["worker_addr"])
-                self._actor_addr_cache[actor_id_hex] = (addr, version)
-                return addr
-            if info["state"] == "DEAD":
-                raise ActorDiedError(
-                    f"Actor {actor_id_hex[:12]} is dead: "
-                    f"{info.get('death_cause', '')}")
+        # change-driven, not polled: the shared hub wakes this waiter on
+        # the actor's state transitions — a 2,000-actor creation burst
+        # costs one Subscribe stream + one GetActorInfo per transition,
+        # not 2,000 outstanding WaitActorUpdate polls
+        ev = self._actor_hub.watch(actor_id_hex)
+        try:
+            while time.monotonic() < deadline:
+                try:
+                    info = await self.gcs.acall(
+                        "GetActorInfo", actor_id=actor_id_hex, timeout=15)
+                except (RpcConnectionError, ConnectionError, OSError,
+                        TimeoutError):
+                    await asyncio.sleep(0.5)
+                    continue
+                if info is None:
+                    raise ActorDiedError(
+                        f"Actor {actor_id_hex[:12]} does not exist")
+                if info["state"] == "ALIVE" and info["worker_addr"]:
+                    addr = tuple(info["worker_addr"])
+                    self._actor_addr_cache[actor_id_hex] = (
+                        addr, info["version"])
+                    return addr
+                if info["state"] == "DEAD":
+                    raise ActorDiedError(
+                        f"Actor {actor_id_hex[:12]} is dead: "
+                        f"{info.get('death_cause', '')}")
+                try:
+                    await asyncio.wait_for(
+                        ev.wait(),
+                        timeout=min(10.0, max(
+                            0.01, deadline - time.monotonic())))
+                except asyncio.TimeoutError:
+                    pass  # re-check against the deadline regardless
+                ev.clear()
+        finally:
+            self._actor_hub.unwatch(actor_id_hex, ev)
         raise ActorUnavailableError(
             f"Actor {actor_id_hex[:12]} not schedulable in time")
 

@@ -43,6 +43,7 @@ class NodeInfo:
     last_heartbeat: float = field(default_factory=time.monotonic)
     is_head: bool = False
     labels: Dict[str, str] = field(default_factory=dict)
+    agent_port: int = 0  # per-node dashboard agent (dashboard/agent.py)
     # autoscaler signal (reference: GcsAutoscalerStateManager)
     pending_shapes: List[Dict[str, float]] = field(default_factory=list)
     num_leases: int = 0
@@ -131,6 +132,7 @@ class GcsServer:
         self.autoscaler_enabled_until = 0.0
         self._dirty = False
         self._needs_replay_reschedule = False
+        self._actor_create_gate = None  # asyncio.Semaphore, loop-affine
         self._wal = None  # lazily-opened append handle
         self._wal_records = 0
         self._wal_degraded = False  # an append failed since last compact
@@ -518,6 +520,7 @@ class GcsServer:
         total_resources: Dict[str, float],
         is_head: bool = False,
         labels: Optional[Dict[str, str]] = None,
+        agent_port: int = 0,
     ) -> dict:
         self.nodes[node_id] = NodeInfo(
             node_id=node_id,
@@ -527,6 +530,7 @@ class GcsServer:
             available_resources=dict(total_resources),
             is_head=is_head,
             labels=labels or {},
+            agent_port=agent_port,
         )
         self._node_version += 1
         logger.info("node %s registered: %s", node_id[:12], total_resources)
@@ -631,6 +635,7 @@ class GcsServer:
                 "AvailableResources": dict(n.available_resources),
                 "IsHead": n.is_head,
                 "Labels": dict(n.labels),
+                "AgentPort": n.agent_port,
             }
             for n in self.nodes.values()
         ]
@@ -856,11 +861,29 @@ class GcsServer:
         candidates.sort()
         return candidates[0][1]
 
+    def _creation_gate(self):
+        """Admission control for actor creation (reference:
+        GcsActorScheduler bounds in-flight leases per node). A burst of
+        thousands of RegisterActor calls must NOT run thousands of
+        lease+spawn+CreateActor pipelines concurrently: on a host whose
+        CPU count is far below the burst size, every stage of every
+        pipeline times out against the others and creation collapses
+        (observed: 624/2000 actors never ALIVE on the 1-CPU CI box).
+        Bounded concurrency turns the herd into a steady pipeline at
+        identical throughput — the stages are CPU-bound anyway."""
+        if self._actor_create_gate is None:
+            self._actor_create_gate = asyncio.Semaphore(
+                max(1, config.actor_creation_concurrency))
+        return self._actor_create_gate
+
     async def _schedule_actor(self, actor: ActorInfo) -> None:
         """Lease a worker for the actor and push its creation task
         (reference: GcsActorScheduler + SchedulePendingActors
-        gcs_actor_manager.cc:1721)."""
-        deadline = time.monotonic() + 300
+        gcs_actor_manager.cc:1721). The creation gate bounds only the
+        lease+CreateActor attempt — an actor merely WAITING for
+        placeable resources holds no slot, so unplaceable actors can't
+        starve the pipeline."""
+        deadline = time.monotonic() + config.actor_schedule_timeout_s
         while time.monotonic() < deadline:
             if actor.state == "DEAD":
                 return
@@ -886,80 +909,13 @@ class GcsServer:
             if node_id is None:
                 await asyncio.sleep(0.2)
                 continue
-            try:
-                raylet = self._raylet(node_id)
-                actor.lease_in_flight = True
-                try:
-                    reply = await raylet.acall(
-                        "RequestWorkerLease",
-                        resources=actor.resources,
-                        scheduling_class=("actor", actor.actor_id),
-                        job_id=actor.job_id,
-                        for_actor=actor.actor_id,
-                        pg_id=actor.pg_id,
-                        bundle_index=actor.bundle_index,
-                        lease_timeout=50.0,
-                        release_cpu_after_grant=actor.cpu_scheduling_only,
-                        runtime_env_hash=actor.runtime_env_hash,
-                        timeout=60,
-                    )
-                finally:
-                    actor.lease_in_flight = False
-            except Exception as e:  # noqa: BLE001
-                logger.warning("actor %s lease request to %s failed: %s", actor.actor_id[:12], node_id[:12], e)
-                await asyncio.sleep(0.5)
-                continue
-            if not reply.get("granted"):
-                await asyncio.sleep(0.2)
-                continue
-            worker_addr = tuple(reply["worker_addr"])
-            try:
-                worker = RpcClient(worker_addr[0], worker_addr[1])
-                creation_reply = await worker.acall(
-                    "CreateActor",
-                    actor_id=actor.actor_id,
-                    serialized_spec=actor.serialized_spec,
-                    # actor __init__ is user code (may cold-import jax,
-                    # build models); the generic RPC timeout would abort
-                    # + re-lease in a loop, never letting init finish
-                    timeout=config.actor_creation_timeout_s,
-                )
-                worker.close()
-            except Exception as e:  # noqa: BLE001
-                logger.warning("actor %s creation push failed: %s", actor.actor_id[:12], e)
-                # the worker may still be running __init__ — return the lease
-                # with worker_dead=True (kills the worker) so the retry can't
-                # produce a second live instance and the lease isn't leaked
-                try:
-                    await self._raylet(node_id).acall(
-                        "ReturnWorkerLease", lease_id=reply["lease_id"], worker_dead=True
-                    )
-                except Exception:
-                    pass
-                await asyncio.sleep(0.5)
-                continue
-            if creation_reply.get("ok"):
-                actor.state = "ALIVE"
-                actor.worker_addr = worker_addr
-                actor.node_id = node_id
-                actor.worker_id = reply.get("worker_id")
-                actor.version += 1
-                self._notify_actor(actor.actor_id)
-                logger.info("actor %s alive on %s", actor.actor_id[:12], node_id[:12])
+            async with self._creation_gate():
+                if actor.state == "DEAD":  # killed while queued at gate
+                    return
+                outcome = await self._try_create_once(actor, node_id)
+            if outcome is None:
                 return
-            else:
-                # creation raised in user __init__ — actor is dead
-                actor.state = "DEAD"
-                actor.death_cause = creation_reply.get("error", "creation failed")
-                actor.version += 1
-                self._notify_actor(actor.actor_id)
-                try:
-                    await self._raylet(node_id).acall(
-                        "ReturnWorkerLease", lease_id=reply["lease_id"], worker_dead=False
-                    )
-                except Exception:
-                    pass
-                return
+            await asyncio.sleep(outcome)
         actor.state = "DEAD"
         if actor.scheduling_kind in ("NODE_AFFINITY", "NODE_LABEL") \
                 and not actor.strategy_soft:
@@ -972,6 +928,82 @@ class GcsServer:
             actor.death_cause = "scheduling timed out (insufficient resources?)"
         actor.version += 1
         self._notify_actor(actor.actor_id)
+
+    async def _try_create_once(self, actor: ActorInfo,
+                               node_id: str) -> Optional[float]:
+        """One gated lease+CreateActor attempt. Returns None when the
+        actor reached a terminal state (ALIVE or DEAD), else the retry
+        delay for the caller's loop."""
+        try:
+            raylet = self._raylet(node_id)
+            actor.lease_in_flight = True
+            try:
+                reply = await raylet.acall(
+                    "RequestWorkerLease",
+                    resources=actor.resources,
+                    scheduling_class=("actor", actor.actor_id),
+                    job_id=actor.job_id,
+                    for_actor=actor.actor_id,
+                    pg_id=actor.pg_id,
+                    bundle_index=actor.bundle_index,
+                    lease_timeout=50.0,
+                    release_cpu_after_grant=actor.cpu_scheduling_only,
+                    runtime_env_hash=actor.runtime_env_hash,
+                    timeout=60,
+                )
+            finally:
+                actor.lease_in_flight = False
+        except Exception as e:  # noqa: BLE001
+            logger.warning("actor %s lease request to %s failed: %s", actor.actor_id[:12], node_id[:12], e)
+            return 0.5
+        if not reply.get("granted"):
+            return 0.2
+        worker_addr = tuple(reply["worker_addr"])
+        try:
+            worker = RpcClient(worker_addr[0], worker_addr[1])
+            creation_reply = await worker.acall(
+                "CreateActor",
+                actor_id=actor.actor_id,
+                serialized_spec=actor.serialized_spec,
+                # actor __init__ is user code (may cold-import jax,
+                # build models); the generic RPC timeout would abort
+                # + re-lease in a loop, never letting init finish
+                timeout=config.actor_creation_timeout_s,
+            )
+            worker.close()
+        except Exception as e:  # noqa: BLE001
+            logger.warning("actor %s creation push failed: %s", actor.actor_id[:12], e)
+            # the worker may still be running __init__ — return the lease
+            # with worker_dead=True (kills the worker) so the retry can't
+            # produce a second live instance and the lease isn't leaked
+            try:
+                await self._raylet(node_id).acall(
+                    "ReturnWorkerLease", lease_id=reply["lease_id"], worker_dead=True
+                )
+            except Exception:
+                pass
+            return 0.5
+        if creation_reply.get("ok"):
+            actor.state = "ALIVE"
+            actor.worker_addr = worker_addr
+            actor.node_id = node_id
+            actor.worker_id = reply.get("worker_id")
+            actor.version += 1
+            self._notify_actor(actor.actor_id)
+            logger.info("actor %s alive on %s", actor.actor_id[:12], node_id[:12])
+            return None
+        # creation raised in user __init__ — actor is dead
+        actor.state = "DEAD"
+        actor.death_cause = creation_reply.get("error", "creation failed")
+        actor.version += 1
+        self._notify_actor(actor.actor_id)
+        try:
+            await self._raylet(node_id).acall(
+                "ReturnWorkerLease", lease_id=reply["lease_id"], worker_dead=False
+            )
+        except Exception:
+            pass
+        return None
 
     def _notify_actor(self, actor_id: str) -> None:
         evt = self._actor_events.get(actor_id)

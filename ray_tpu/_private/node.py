@@ -43,18 +43,21 @@ def default_node_resources(
     num_tpus: Optional[float] = None,
     resources: Optional[Dict[str, float]] = None,
 ) -> Dict[str, float]:
-    from ray_tpu.accelerators import tpu as tpu_accel
+    from ray_tpu.accelerators import get_all_accelerator_managers
 
     out: Dict[str, float] = dict(resources or {})
     out["CPU"] = float(num_cpus) if num_cpus is not None else float(os.cpu_count() or 1)
     if num_tpus is not None:
         out["TPU"] = float(num_tpus)
-    else:
-        n = tpu_accel.TPUAcceleratorManager.get_current_node_num_accelerators()
-        if n:
-            out["TPU"] = float(n)
+    # every registered backend detects through the same ABC (reference:
+    # _private/accelerators — 8 plugins behind one surface)
+    for name, mgr in get_all_accelerator_managers().items():
+        if name not in out:
+            n = mgr.get_current_node_num_accelerators()
+            if n:
+                out[name] = float(n)
+        out.update(mgr.get_current_node_additional_resources())
     out.setdefault("memory", float(psutil.virtual_memory().available // 2))
-    out.update(tpu_accel.TPUAcceleratorManager.get_current_node_additional_resources())
     node_ip = "127.0.0.1"
     out[f"node:{node_ip}"] = 1.0
     return out
@@ -80,7 +83,9 @@ def spawn_gcs(port: int, session_dir: str, log_name: str = "gcs.log") -> subproc
         stderr=subprocess.STDOUT,
     )
     client = RpcClient("127.0.0.1", port)
-    deadline = time.monotonic() + 30
+    # generous: a loaded CI box (a full suite's worth of processes on
+    # one core) can take >30s just to schedule the interpreter start
+    deadline = time.monotonic() + 60
     while True:
         try:
             client.call("Ping", timeout=2)

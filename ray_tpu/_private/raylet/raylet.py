@@ -19,8 +19,10 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import json
 import logging
 import os
+import signal
 import subprocess
 import sys
 import tempfile
@@ -76,10 +78,129 @@ class ResourceSet:
             self.free_tpu_chips.sort()
 
 
+class ZygoteProc:
+    """Popen-shaped view of a worker forked by the zygote (the zygote,
+    not this raylet, is its parent — liveness comes from a pidfd, which
+    signals readable once the process exits, zombie included).
+    Readiness is checked with select.poll(), NOT select.select(): with
+    thousands of workers each holding a pidfd plus sockets, fds exceed
+    1023 and select() raises. The no-pidfd fallback pins the process's
+    create time so a recycled pid (the zygote reaps promptly) cannot
+    impersonate a live worker."""
+
+    def __init__(self, pid: int):
+        self.pid = pid
+        self.returncode: Optional[int] = None
+        self._create_time: Optional[float] = None
+        try:
+            self._pidfd = os.pidfd_open(pid)
+        except (OSError, AttributeError) as e:
+            self._pidfd = None
+            logger.warning("pidfd_open(%d) failed (%s); falling back to "
+                           "create-time liveness probing", pid, e)
+            try:
+                import psutil
+
+                self._create_time = psutil.Process(pid).create_time()
+            except Exception:  # noqa: BLE001 — already gone
+                self.returncode = 0
+
+    def poll(self) -> Optional[int]:
+        if self.returncode is not None:
+            return self.returncode
+        if self._pidfd is not None:
+            import select as _select
+
+            p = _select.poll()
+            p.register(self._pidfd, _select.POLLIN)
+            if not p.poll(0):
+                return None
+        else:
+            try:
+                import psutil
+
+                if psutil.Process(self.pid).create_time() == \
+                        self._create_time:
+                    return None
+            except Exception:  # noqa: BLE001 — gone or recycled
+                pass
+        self.returncode = 0  # exit code unknowable for a non-child
+        if self._pidfd is not None:
+            try:
+                os.close(self._pidfd)
+            except OSError:
+                pass
+            self._pidfd = None
+        return self.returncode
+
+    def terminate(self) -> None:
+        try:
+            os.kill(self.pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+
+    def kill(self) -> None:
+        try:
+            os.kill(self.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[int]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self.poll() is None:
+            if deadline is not None and time.monotonic() > deadline:
+                raise subprocess.TimeoutExpired("zygote-worker", timeout)
+            time.sleep(0.02)
+        return self.returncode
+
+
+class Zygote:
+    """Client for the prefork worker factory (workers/zygote.py): one
+    warmed child process; each spawn request forks it in ~ms instead of
+    paying a cold interpreter + import chain per worker."""
+
+    def __init__(self, env: Dict[str, str], session_dir: str):
+        self._lock = threading.Lock()
+        self._log = open(os.path.join(session_dir, "zygote.log"), "ab")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.workers.zygote"],
+            env=env,
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            stderr=self._log,
+        )
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def spawn(self, env: Dict[str, str], log_path: str) -> int:
+        msg = json.dumps({"env": env, "log_path": log_path}) + "\n"
+        with self._lock:
+            self.proc.stdin.write(msg.encode())
+            self.proc.stdin.flush()
+            line = self.proc.stdout.readline()
+        if not line:
+            raise RuntimeError("zygote exited")
+        reply = json.loads(line)
+        if "pid" not in reply:
+            raise RuntimeError(f"zygote spawn failed: {reply.get('error')}")
+        return reply["pid"]
+
+    def stop(self) -> None:
+        try:
+            self.proc.terminate()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            self._log.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
 @dataclass
 class WorkerHandle:
     worker_id: str
-    proc: subprocess.Popen
+    proc: Any  # subprocess.Popen | ZygoteProc
     addr: Optional[Tuple[str, int]] = None
     registered: asyncio.Event = field(default_factory=asyncio.Event)
     busy_lease: Optional[str] = None
@@ -101,6 +222,7 @@ class Lease:
     for_actor: Optional[str] = None
     blocked: bool = False  # worker is blocked in get(); CPU released
     cpu_released: bool = False  # actor lease: CPU returned after grant
+    granted_at: float = field(default_factory=time.monotonic)
 
 
 @dataclass
@@ -145,6 +267,9 @@ class Raylet:
         self.prepared_bundles: Dict[Tuple[str, int], Dict[str, Any]] = {}
         self.committed_bundles: Dict[Tuple[str, int], "ResourceSet"] = {}
         self._starting_workers = 0
+        self._zygote: Optional[Zygote] = None
+        self._zygote_lock = threading.Lock()
+        self.num_oom_kills = 0
         # single-consumer drain: _drain_pending rebuilds self.pending and
         # must never run reentrantly (two interleaved drains clobber each
         # other's rebuild); callers kick the event instead of calling it
@@ -183,10 +308,10 @@ class Raylet:
     # ------------------------------------------------------------------
     # Worker pool (reference: worker_pool.h:280)
     # ------------------------------------------------------------------
-    def _spawn_worker(self) -> WorkerHandle:
-        worker_id = uuid.uuid4().hex
+    def _worker_env(self, worker_id: str = "") -> Dict[str, str]:
         env = dict(os.environ)
-        env["RAY_TPU_WORKER_ID"] = worker_id
+        if worker_id:
+            env["RAY_TPU_WORKER_ID"] = worker_id
         env["RAY_TPU_RAYLET_ADDR"] = f"{self.server.host}:{self.server.port}"
         env["RAY_TPU_GCS_ADDR"] = f"{self.gcs_addr[0]}:{self.gcs_addr[1]}"
         env["RAY_TPU_STORE_SOCKET"] = self.store_socket
@@ -195,14 +320,51 @@ class Raylet:
         # workers must not grab the TPU runtime at import; chips are
         # assigned per-lease via TPU_VISIBLE_CHIPS
         env.setdefault("JAX_PLATFORMS", "")
+        return env
+
+    def _get_zygote(self) -> Optional[Zygote]:
+        if not config.worker_zygote_enabled:
+            return None
+        # _spawn_worker runs on executor threads — without the lock a
+        # spawn burst would race two Zygote() constructions and orphan
+        # one warmed process
+        with self._zygote_lock:
+            z = self._zygote
+            if z is not None and z.alive():
+                return z
+            if z is not None:
+                z.stop()
+            try:
+                # lazily (re)started: the server port is only known after
+                # start, and a crashed zygote must not take the pool down
+                self._zygote = Zygote(self._worker_env(), self.session_dir)
+            except Exception:  # noqa: BLE001
+                logger.exception("zygote start failed; using cold spawns")
+                self._zygote = None
+            return self._zygote
+
+    def _spawn_worker(self) -> WorkerHandle:
+        worker_id = uuid.uuid4().hex
         log_path = os.path.join(self.session_dir, f"worker-{worker_id[:8]}.log")
-        with open(log_path, "ab") as logf:
-            proc = subprocess.Popen(
-                [sys.executable, "-m", "ray_tpu._private.workers.default_worker"],
-                env=env,
-                stdout=logf,
-                stderr=subprocess.STDOUT,
-            )
+        proc: Any = None
+        zygote = self._get_zygote()
+        if zygote is not None:
+            try:
+                pid = zygote.spawn({"RAY_TPU_WORKER_ID": worker_id},
+                                   log_path)
+                proc = ZygoteProc(pid)
+            except Exception:  # noqa: BLE001
+                logger.exception("zygote spawn failed; cold spawn instead")
+        if proc is None:
+            env = self._worker_env(worker_id)
+            with open(log_path, "ab") as logf:
+                proc = subprocess.Popen(
+                    [sys.executable, "-m",
+                     "ray_tpu._private.workers.default_worker"],
+                    env=env,
+                    stdout=logf,
+                    stderr=subprocess.STDOUT,
+                )
         handle = WorkerHandle(worker_id=worker_id, proc=proc)
         self.workers[worker_id] = handle
         return handle
@@ -259,7 +421,10 @@ class Raylet:
                 victim.worker_id[:8], victim.env_hash[:8] or "<clean>")
         self._starting_workers += 1
         try:
-            handle = self._spawn_worker()
+            # executor thread: a zygote boot (first spawn) or a cold
+            # Popen must not stall the raylet's event loop
+            handle = await asyncio.get_event_loop().run_in_executor(
+                None, self._spawn_worker)
             logger.debug("spawning worker %s (pid %s)", handle.worker_id[:8], handle.proc.pid)
             try:
                 await asyncio.wait_for(
@@ -963,6 +1128,7 @@ class Raylet:
             "spilled_objects": n_spilled,
             "spilled_bytes_total": self._spilled_bytes_total,
             "restored_bytes_total": self._restored_bytes_total,
+            "num_oom_kills": self.num_oom_kills,
         }
 
     async def Ping(self) -> str:
@@ -1102,6 +1268,62 @@ class Raylet:
                 except Exception:  # noqa: BLE001
                     pass
 
+    # -- OOM worker killing (reference: raylet memory monitor +
+    # worker_killing_policy_group_by_owner.h: under host-memory
+    # pressure, kill a worker from the owner-group with the MOST
+    # workers — the fan-out most likely responsible — youngest first,
+    # so the least progress is lost and its retriable task resubmits) --
+    def _memory_pct(self) -> float:
+        path = config.testing_memory_pct_file
+        if path:
+            try:
+                with open(path) as f:
+                    return float(f.read().strip())
+            except (OSError, ValueError):
+                return 0.0
+        import psutil
+
+        return float(psutil.virtual_memory().percent)
+
+    def _pick_oom_victim(self) -> Optional["Lease"]:
+        groups: Dict[Tuple, List[Lease]] = {}
+        for lease in self.leases.values():
+            if lease.worker.dead:
+                continue
+            # group by owner: the job, with each actor its own group
+            # (reference groups by the task owner's id)
+            key = (lease.job_id, lease.for_actor or "")
+            groups.setdefault(key, []).append(lease)
+        if not groups:
+            return None
+        biggest = max(groups.values(), key=len)
+        return max(biggest, key=lambda le: le.granted_at)  # youngest
+
+    async def _memory_monitor_loop(self) -> None:
+        while True:
+            await asyncio.sleep(config.memory_monitor_period_s)
+            if config.memory_usage_threshold >= 1.0:
+                continue  # disabled
+            pct = self._memory_pct()
+            if pct < config.memory_usage_threshold * 100.0:
+                continue
+            victim = self._pick_oom_victim()
+            if victim is None:
+                continue
+            logger.warning(
+                "memory pressure %.0f%% >= %.0f%%: killing worker %s "
+                "(job %s, group-by-owner policy)", pct,
+                config.memory_usage_threshold * 100.0,
+                victim.worker.worker_id[:8], victim.job_id[:8])
+            victim.worker.dead = True
+            try:
+                victim.worker.proc.kill()
+            except Exception:  # noqa: BLE001
+                pass
+            self.num_oom_kills += 1
+            # the reap loop + caller-side worker-failure handling do the
+            # rest: lease released, task retried elsewhere
+
     async def _idle_reaper_loop(self) -> None:
         while True:
             await asyncio.sleep(5)
@@ -1128,6 +1350,7 @@ class Raylet:
             total_resources=self.resources.total,
             is_head=self.is_head,
             labels=self.labels,
+            agent_port=getattr(self, "agent_port", 0),
             timeout=30,
         )
 
@@ -1147,10 +1370,21 @@ class Raylet:
         # wait until the port is bound
         while self.server.port == 0:
             await asyncio.sleep(0.01)
+        # per-node observability agent, colocated on this event loop
+        # (reference: dashboard/agent.py:35 — one agent per node)
+        try:
+            from ray_tpu.dashboard.agent import NodeAgent
+
+            self.agent = NodeAgent(self, host=self.server.host)
+            _, self.agent_port = await self.agent.start()
+        except Exception:  # noqa: BLE001 — observability must not block boot
+            logger.exception("node agent failed to start")
+            self.agent_port = 0
         await self._register()
         asyncio.ensure_future(self._heartbeat_loop())
         asyncio.ensure_future(self._reap_loop())
         asyncio.ensure_future(self._idle_reaper_loop())
+        asyncio.ensure_future(self._memory_monitor_loop())
         asyncio.ensure_future(self._drain_loop())
         asyncio.ensure_future(self._pull_pin_sweeper_loop())
         if config.log_to_driver:
@@ -1169,6 +1403,8 @@ class Raylet:
                 w.proc.terminate()
             except Exception:
                 pass
+        if self._zygote is not None:
+            self._zygote.stop()
         if self.store_proc is not None:
             try:
                 self.store_proc.terminate()

@@ -462,13 +462,20 @@ class WorkerServer:
         self.raylet_addr = raylet_addr
         self.actors: Dict[str, _ActorRunner] = {}
         self._task_pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="exec")
-        self._function_cache: Dict[bytes, Any] = {}
+        from collections import OrderedDict
+
+        # bytes -> fn, LRU-bounded: each entry pins the full cloudpickle
+        # byte string as its key, so an unbounded dict would grow with
+        # every distinct closure this worker ever ran
+        self._function_cache: Any = OrderedDict()
+        self._fn_by_key: Any = OrderedDict()  # content hash -> fn (LRU)
         # task_id bin -> executing thread ident, for CancelTask; the lock
         # makes register/raise/unregister mutually exclusive so a cancel
         # cannot target a thread that already moved on to another task
         self._running_tasks: Dict[bytes, int] = {}
         self._cancel_lock = threading.Lock()
         core.server.register("PushTask", self.PushTask)
+        core.server.register("PushTaskBatch", self.PushTaskBatch)
         core.server.register("CancelTask", self.CancelTask)
         core.server.register("CreateActor", self.CreateActor)
         core.server.register("PushActorTask", self.PushActorTask)
@@ -520,12 +527,29 @@ class WorkerServer:
             raise
 
     # -- normal tasks ---------------------------------------------------
-    def PushTask(self, spec_payload: dict) -> dict:
-        self._apply_py_paths(spec_payload.get("py_paths"))
-        self._apply_runtime_env(spec_payload.get("runtime_env"))
-        fn_bytes = spec_payload["serialized_function"]
+    _FN_KEY_CACHE_MAX = 512
+    _FN_BYTES_CACHE_MAX = 64
+
+    def _resolve_function(self, spec_payload: dict):
+        """Function bytes ship once per worker: later pushes carry only
+        ``function_key`` (content hash of the cloudpickle bytes) and hit
+        the key cache (reference: the function table exported through
+        the GCS once per job, _private/function_manager.py). Returns
+        (fn, None) or (None, error_reply)."""
+        key = spec_payload.get("function_key")
+        fn_bytes = spec_payload.get("serialized_function")
+        if fn_bytes is None:
+            fn = self._fn_by_key.get(key)
+            if fn is None:
+                # evicted (or a restarted worker the driver mistook for
+                # warm): ask for the bytes instead of failing the task
+                return None, {"need_function": True}
+            self._fn_by_key.move_to_end(key)
+            return fn, None
         fn = self._function_cache.get(fn_bytes)
-        if fn is None:
+        if fn is not None:
+            self._function_cache.move_to_end(fn_bytes)
+        else:
             try:
                 fn = loads_function(fn_bytes)
             except BaseException as e:  # noqa: BLE001
@@ -538,14 +562,30 @@ class WorkerServer:
                 )
                 if spec_payload.get("streaming"):
                     # streams have no return slots: surface via stream error
-                    return {"returns": [], "streaming_done": 0, "stream_error": err}
-                return {
+                    return None, {"returns": [], "streaming_done": 0,
+                                  "stream_error": err}
+                return None, {
                     "returns": [
                         {"kind": "inline", "data": err}
                         for _ in range(spec_payload["num_returns"])
                     ]
                 }
             self._function_cache[fn_bytes] = fn
+            while len(self._function_cache) > self._FN_BYTES_CACHE_MAX:
+                self._function_cache.popitem(last=False)
+        if key:
+            self._fn_by_key[key] = fn
+            self._fn_by_key.move_to_end(key)
+            while len(self._fn_by_key) > self._FN_KEY_CACHE_MAX:
+                self._fn_by_key.popitem(last=False)
+        return fn, None
+
+    def PushTask(self, spec_payload: dict) -> dict:
+        self._apply_py_paths(spec_payload.get("py_paths"))
+        self._apply_runtime_env(spec_payload.get("runtime_env"))
+        fn, err_reply = self._resolve_function(spec_payload)
+        if err_reply is not None:
+            return err_reply
         caller_addr = spec_payload.get("caller_addr")
         if spec_payload.get("streaming"):
             fut = self._task_pool.submit(
@@ -579,6 +619,36 @@ class WorkerServer:
                     self._running_tasks.pop(task_bin, None)
 
         return self._task_pool.submit(_runner).result()
+
+    def PushTaskBatch(self, spec_payloads: list) -> dict:
+        """Execute a batch of queued same-class tasks serially in one
+        RPC roundtrip (reference: the raylet's lease reuse amortizes
+        scheduling, but each reference task still pays one PushTask RPC
+        — batching amortizes the roundtrip too, which dominates for
+        small tasks).
+
+        Each task's reply is pushed to the caller the moment it
+        finishes (oneway ``NormalTaskDone``) so an early result is
+        visible to ``ray.wait`` while later batch members still run;
+        the positional ``replies`` in the final return are the reliable
+        fallback for a lost push — the caller claims each (task,
+        attempt) exactly once."""
+        replies = []
+        for p in spec_payloads:
+            r = self.PushTask(p)
+            replies.append(r)
+            addr = p.get("caller_addr")
+            if addr and not r.get("need_function"):
+                try:
+                    get_client(tuple(addr)).call_oneway(
+                        "NormalTaskDone",
+                        task_id_bin=p["task_id"],
+                        attempt_number=p.get("attempt_number", 0),
+                        reply=r,
+                    )
+                except Exception:  # noqa: BLE001 — fallback is the reply
+                    pass
+        return {"replies": replies}
 
     def CancelTask(self, task_id_bin: bytes, force: bool = False) -> dict:
         """Interrupt a RUNNING task (reference: CoreWorker::HandleCancelTask,
@@ -670,6 +740,21 @@ class WorkerServer:
 
 def main() -> None:
     logging.basicConfig(level="INFO", format="[worker] %(levelname)s %(message)s")
+    # honor JAX_PLATFORMS via jax.config: environment-level platform
+    # pinning can be overridden by site hooks that call
+    # jax.config.update("jax_platforms", ...) at interpreter start
+    # (e.g. a tunneled-TPU plugin forcing itself first) — a worker
+    # told to run CPU must NEVER lazily initialize a remote TPU
+    # backend mid-task (observed: CreateActor unpickling a jax array
+    # hung on the tunnel). config.update after import wins.
+    jp = os.environ.get("JAX_PLATFORMS")
+    if jp:
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", jp)
+        except Exception:  # noqa: BLE001 — jax absent or config gone
+            pass
     worker_id = os.environ["RAY_TPU_WORKER_ID"]
     raylet_host, raylet_port = os.environ["RAY_TPU_RAYLET_ADDR"].rsplit(":", 1)
     gcs_host, gcs_port = os.environ["RAY_TPU_GCS_ADDR"].rsplit(":", 1)

@@ -1,8 +1,11 @@
 from ray_tpu.accelerators.accelerator import AcceleratorManager
+from ray_tpu.accelerators.fake_chip import FakeChipAcceleratorManager
 from ray_tpu.accelerators.tpu import TPUAcceleratorManager
 
 _MANAGERS = {
     "TPU": TPUAcceleratorManager,
+    # proof-of-ABC backend, active only under RAY_TPU_FAKE_CHIP_COUNT
+    "FakeChip": FakeChipAcceleratorManager,
 }
 
 

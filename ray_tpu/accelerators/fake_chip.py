@@ -1,0 +1,60 @@
+"""FakeChip — a second accelerator backend that exists to prove the
+plugin ABC (reference: python/ray/_private/accelerators/ ships eight
+backends; an interface with one implementation is untested by
+construction).
+
+Activated by ``RAY_TPU_FAKE_CHIP_COUNT=N`` — node resource detection
+then reports N ``FakeChip`` units through exactly the same
+AcceleratorManager surface TPU uses, and tests schedule against them
+without any hardware. Also the model for adding a real second backend:
+implement the ABC, add one line to ``accelerators._MANAGERS``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from ray_tpu.accelerators.accelerator import AcceleratorManager
+
+FAKE_CHIP_RESOURCE = "FakeChip"
+FAKE_CHIP_COUNT_ENV = "RAY_TPU_FAKE_CHIP_COUNT"
+FAKE_CHIP_VISIBLE_ENV = "FAKECHIP_VISIBLE_IDS"
+
+
+class FakeChipAcceleratorManager(AcceleratorManager):
+    @staticmethod
+    def get_resource_name() -> str:
+        return FAKE_CHIP_RESOURCE
+
+    @staticmethod
+    def get_visible_accelerator_ids_env_var() -> str:
+        return FAKE_CHIP_VISIBLE_ENV
+
+    @staticmethod
+    def get_current_node_num_accelerators() -> int:
+        try:
+            return int(os.environ.get(FAKE_CHIP_COUNT_ENV, "0"))
+        except ValueError:
+            return 0
+
+    @staticmethod
+    def get_current_node_accelerator_type() -> Optional[str]:
+        return "FAKE-CHIP-V1" if \
+            FakeChipAcceleratorManager.get_current_node_num_accelerators() \
+            else None
+
+    @staticmethod
+    def get_current_process_visible_accelerator_ids() -> Optional[List[str]]:
+        v = os.environ.get(FAKE_CHIP_VISIBLE_ENV)
+        return v.split(",") if v else None
+
+    @staticmethod
+    def set_current_process_visible_accelerator_ids(ids: List[str]) -> None:
+        os.environ[FAKE_CHIP_VISIBLE_ENV] = ",".join(str(i) for i in ids)
+
+    @staticmethod
+    def validate_resource_request_quantity(quantity: float) -> tuple:
+        if quantity != int(quantity):
+            return (False, "FakeChip must be requested in whole units")
+        return (True, "")

@@ -61,14 +61,21 @@ def _detect_chips_from_devfs() -> int:
 
 
 def _detect_chips_from_jax() -> int:
-    """Last-resort detection via an initialized jax runtime (only if jax is
-    already imported — we never import jax here to keep startup light)."""
+    """Last-resort detection via an initialized jax runtime — only if a
+    backend ALREADY exists. jax.devices() on a cold runtime would
+    initialize the platform plugin here, inside resource detection: slow
+    at best, and a remote/tunneled TPU runtime that is down blocks
+    ray_tpu.init() indefinitely."""
     import sys
 
     jax = sys.modules.get("jax")
     if jax is None:
         return 0
     try:
+        from jax._src import xla_bridge as _xb
+
+        if not getattr(_xb, "_backends", None):
+            return 0  # no backend initialized; never trigger init here
         return len([d for d in jax.devices() if "tpu" in d.platform.lower() or "TPU" in str(d)])
     except Exception:
         return 0

@@ -147,6 +147,13 @@ class DashboardHead:
                     )
                     return 200, "application/json", _json_bytes(
                         {"submission_id": sid})
+            if method == "GET" and path.startswith("/api/nodes/"):
+                # proxy to the owning node's agent (reference:
+                # dashboard/agent.py — per-node logs/stats without
+                # funneling bulk data through the GCS)
+                rest = path[len("/api/nodes/"):]
+                node_id, _, agent_path = rest.partition("/")
+                return self._proxy_agent(node_id, agent_path)
             if path.startswith("/api/jobs/"):
                 rest = path[len("/api/jobs/"):]
                 if rest.endswith("/logs") and method == "GET":
@@ -167,6 +174,29 @@ class DashboardHead:
                 {"error": f"no route {method} {path}"})
         except Exception as e:  # noqa: BLE001
             return 500, "application/json", _json_bytes({"error": str(e)})
+
+    def _proxy_agent(self, node_id: str,
+                     agent_path: str) -> Tuple[int, str, bytes]:
+        nodes = self._gcs().call("GetAllNodeInfo", timeout=10) or []
+        node = next((n for n in nodes if n["NodeID"] == node_id
+                     or n["NodeID"].startswith(node_id)), None)
+        if node is None:
+            return 404, "application/json", _json_bytes(
+                {"error": f"no node {node_id!r}"})
+        port = node.get("AgentPort") or 0
+        if not port:
+            return 502, "application/json", _json_bytes(
+                {"error": "node has no agent"})
+        import urllib.request
+
+        url = (f"http://{node['NodeManagerAddress']}:{port}"
+               f"/api/local/{agent_path}")
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                return resp.status, "application/json", resp.read()
+        except Exception as e:  # noqa: BLE001
+            return 502, "application/json", _json_bytes(
+                {"error": f"agent unreachable: {e}"})
 
     def _html(self) -> str:
         from html import escape as esc

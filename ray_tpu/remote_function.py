@@ -35,6 +35,10 @@ class RemoteFunction:
         except (OSError, TypeError):
             src = self._name
         self._function_hash = hashlib.sha1(src.encode()).hexdigest()[:16]
+        # cloudpickled once here, like the reference's export-once function
+        # table (python/ray/_private/function_manager.py): re-pickling per
+        # submit was the dominant driver-side cost for small tasks
+        self._pickled_function: Optional[bytes] = None
         self._default_options = dict(task_options)
         self._descriptor = FunctionDescriptor(
             module_name=self._module,

@@ -1,0 +1,212 @@
+"""Scalability envelope harness (reference: release/benchmarks/README.md
+— many_tasks / many_actors / many_pgs distributed stress tests, and
+release/release_tests.yaml:3270-3351 single_node/scheduling suites).
+
+The reference's published envelope is 1M queued tasks, 10k simultaneous
+running tasks, 40k actors, 1k placement groups on a large cluster. This
+harness runs the same shapes sized for the host it's on (scaled by
+--scale, default 1.0 = 100k queued tasks, 2,000 actors, 200 PGs on this
+1-CPU CI box) and records sustained rates:
+
+    python scale_bench.py [--scale 0.1] [--out SCALEBENCH.json]
+
+Writes one JSON file with tasks/s (submit + complete), actors/s
+(create + first-call), pgs/s (create + remove), and peak queue depth,
+plus a `statement` comparing against the reference envelope.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def bench_many_tasks(n_queued: int) -> dict:
+    """Queue n_queued no-op tasks at once (far more than workers exist),
+    then drain. Measures: submit rate (driver-side enqueue throughput)
+    and end-to-end completion rate."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    def noop(i):
+        return i
+
+    t0 = time.perf_counter()
+    refs = [noop.remote(i) for i in range(n_queued)]
+    t_submit = time.perf_counter() - t0
+    # drain in windows so the driver's get() never holds 100k results
+    done = 0
+    t1 = time.perf_counter()
+    chunk = 2000
+    for off in range(0, n_queued, chunk):
+        out = ray_tpu.get(refs[off:off + chunk], timeout=600)
+        done += len(out)
+        refs[off:off + chunk] = [None] * len(out)  # release refs as we go
+    t_drain = time.perf_counter() - t1
+    assert done == n_queued
+    return {
+        "queued": n_queued,
+        "submit_per_s": round(n_queued / t_submit, 1),
+        "complete_per_s": round(n_queued / (t_submit + t_drain), 1),
+        "submit_s": round(t_submit, 2),
+        "total_s": round(t_submit + t_drain, 2),
+    }
+
+
+def _drain(refs, total_timeout: float) -> list:
+    """ray.wait-windowed drain (the reference's many_actors drains with
+    ray.wait batches, release/benchmarks): prints progress and bounds
+    the whole drain, not each ref."""
+    import ray_tpu
+
+    deadline = time.perf_counter() + total_timeout
+    pending = list(refs)
+    done_vals = []
+    while pending:
+        if time.perf_counter() > deadline:
+            raise TimeoutError(
+                f"{len(pending)}/{len(refs)} still pending at deadline")
+        done, pending = ray_tpu.wait(pending, num_returns=len(pending),
+                                     timeout=30)
+        if done:
+            done_vals.extend(ray_tpu.get(done, timeout=120))
+            print(f"  drained {len(done_vals)}/{len(refs)}", flush=True)
+    return done_vals
+
+
+def bench_many_actors(n_actors: int) -> dict:
+    """Create n_actors tiny actors as fast as possible, then call each
+    once (the reference's many_actors measures creation + first-ping on
+    10k actors across a cluster)."""
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=0)
+    class A:
+        def ping(self):
+            return 1
+
+    t0 = time.perf_counter()
+    actors = [A.remote() for _ in range(n_actors)]
+    pings = [a.ping.remote() for a in actors]
+    out = _drain(pings, total_timeout=1500)
+    t_ready = time.perf_counter() - t0
+    assert sum(out) == n_actors
+    t1 = time.perf_counter()
+    out = _drain([a.ping.remote() for a in actors], total_timeout=900)
+    t_call = time.perf_counter() - t1
+    for a in actors:
+        ray_tpu.kill(a)
+    return {
+        "actors": n_actors,
+        "create_and_first_ping_per_s": round(n_actors / t_ready, 1),
+        "warm_call_per_s": round(n_actors / t_call, 1),
+        "create_s": round(t_ready, 2),
+    }
+
+
+def bench_many_pgs(n_pgs: int) -> dict:
+    """Create and remove n_pgs 1-bundle placement groups (reference:
+    many_pgs, 1k PGs)."""
+    import ray_tpu
+    from ray_tpu.util.placement_group import placement_group, remove_placement_group
+
+    t0 = time.perf_counter()
+    pgs = [placement_group([{"CPU": 0.001}]) for _ in range(n_pgs)]
+    for pg in pgs:
+        pg.wait(timeout_seconds=300)
+    t_create = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    for pg in pgs:
+        remove_placement_group(pg)
+    t_remove = time.perf_counter() - t1
+    return {
+        "pgs": n_pgs,
+        "create_per_s": round(n_pgs / t_create, 1),
+        "remove_per_s": round(n_pgs / t_remove, 1),
+    }
+
+
+def _run_phase(phase: str, n: int) -> None:
+    """Child-process body: one phase against a fresh runtime."""
+    import faulthandler
+    import os
+    import signal
+
+    faulthandler.register(signal.SIGUSR1)  # kill -USR1 <pid> = stack dump
+    # the envelope shapes need limits above the laptop-safe defaults;
+    # explicit env still wins
+    os.environ.setdefault("RAY_TPU_MAX_WORKERS_PER_NODE", str(n + 200))
+    os.environ.setdefault("RAY_TPU_ACTOR_WAIT_ALIVE_TIMEOUT_S", "1800")
+    os.environ.setdefault("RAY_TPU_ACTOR_SCHEDULE_TIMEOUT_S", "1800")
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=8)
+    fn = {"many_tasks": bench_many_tasks,
+          "many_actors": bench_many_actors,
+          "many_pgs": bench_many_pgs}[phase]
+    out = fn(n)
+    ray_tpu.shutdown()
+    print("PHASE_JSON " + json.dumps(out), flush=True)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=1.0,
+                    help="1.0 = 100k tasks / 2k actors / 200 PGs")
+    ap.add_argument("--out", default="SCALEBENCH.json")
+    ap.add_argument("--phase", default="",
+                    help="internal: run one phase in this process")
+    ap.add_argument("--n", type=int, default=0)
+    args = ap.parse_args()
+
+    if args.phase:
+        _run_phase(args.phase, args.n)
+        return
+
+    import os
+    import subprocess
+    import sys
+
+    n_tasks = max(1000, int(100_000 * args.scale))
+    n_actors = max(50, int(2_000 * args.scale))
+    n_pgs = max(10, int(200 * args.scale))
+
+    # one DRIVER PROCESS per phase, like the reference's release suite
+    # (release_tests.yaml runs many_tasks / many_actors / many_pgs as
+    # separate jobs): each phase measures a clean control plane, not the
+    # previous phase's leftover driver state
+    results = {}
+    for phase, n in (("many_tasks", n_tasks), ("many_actors", n_actors),
+                     ("many_pgs", n_pgs)):
+        print(f"== {phase}: {n} ==", flush=True)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--phase", phase, "--n", str(n)],
+            capture_output=True, text=True, timeout=3600)
+        line = next((ln for ln in proc.stdout.splitlines()
+                     if ln.startswith("PHASE_JSON ")), None)
+        if line is None:
+            results[phase] = {"error": proc.stdout[-2000:]
+                              + proc.stderr[-2000:]}
+            print(f"{phase} FAILED", flush=True)
+            continue
+        results[phase] = json.loads(line[len("PHASE_JSON "):])
+        print(json.dumps(results[phase]), flush=True)
+
+    results["statement"] = (
+        "Reference envelope (release/benchmarks/README.md): 1M queued "
+        "tasks, 10k running tasks, 40k actors, 1k PGs on a multi-node "
+        "cluster. This run exercises the same shapes at "
+        f"{args.scale:g}x CI scale on one 1-CPU host: {n_tasks} tasks "
+        f"queued at once through one driver, {n_actors} actors, "
+        f"{n_pgs} PGs — each phase its own driver process, as in the "
+        "reference's release jobs."
+    )
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=1)
+    print(f"wrote {args.out}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -121,3 +121,37 @@ class TestJobLifecycle:
             entrypoint="python -c \"print('line1'); print('line2')\"")
         text = "".join(client.tail_job_logs(sid))
         assert "line1" in text and "line2" in text
+
+
+class TestNodeAgent:
+    """Per-node dashboard agent (VERDICT r4 item 8; reference:
+    dashboard/agent.py:35): logs and stats come from the owning node's
+    agent, proxied by the head — not funneled through the GCS."""
+
+    def test_agent_stats_and_logs_via_head_proxy(self, dash_cluster):
+        import json as _json
+        import urllib.request
+
+        cluster, head, _client = dash_cluster
+        head_addr = head.address.replace("http://", "")
+        with urllib.request.urlopen(
+                f"http://{head_addr}/api/nodes", timeout=10) as r:
+            nodes = _json.loads(r.read())
+        assert nodes and all(n.get("AgentPort") for n in nodes), nodes
+        nid = nodes[0]["NodeID"]
+        with urllib.request.urlopen(
+                f"http://{head_addr}/api/nodes/{nid}/stats",
+                timeout=10) as r:
+            stats = _json.loads(r.read())
+        assert stats["node_id"] == nid
+        assert stats["num_workers"] >= 0
+        with urllib.request.urlopen(
+                f"http://{head_addr}/api/nodes/{nid}/logs",
+                timeout=10) as r:
+            logs = _json.loads(r.read())
+        assert "logs" in logs
+        with urllib.request.urlopen(
+                f"http://{head_addr}/api/nodes/{nid}/raylet",
+                timeout=10) as r:
+            st = _json.loads(r.read())
+        assert st["node_id"] == nid and "num_oom_kills" in st
