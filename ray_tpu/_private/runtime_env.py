@@ -9,11 +9,18 @@ fields are supported natively:
   KV (content-addressed) and extracted + chdir'd + sys.path'd on the
   worker,
 - ``py_modules``: list of local directories, shipped the same way and
-  added to sys.path.
+  added to sys.path,
+- ``pip`` / ``uv``: a list of requirement strings — the worker's node
+  builds a virtualenv for that exact requirement set ONCE
+  (content-hash-addressed under the node cache, ``uv`` preferred for
+  speed, ``--system-site-packages`` so this framework and jax stay
+  importable — reference: _private/runtime_env/pip.py:300, uv.py), and
+  the worker activates it by prepending its site-packages. Workers are
+  dedicated per env hash (raylet pool), so activation never crosses
+  envs.
 
-``pip``/``conda``/``uv`` are rejected with a clear error (no package
-installation in this image; reference gates these behind the runtime-env
-agent).
+``conda``/``container`` are rejected with a clear error (reference
+gates those behind the runtime-env agent + image tooling).
 
 Worker semantics: applying an env marks the worker (env vars stay set,
 paths stay on sys.path) — the reference dedicates workers to a runtime
@@ -31,7 +38,7 @@ import zipfile
 from typing import Any, Dict, List, Optional, Tuple
 
 PKG_NAMESPACE = "runtime_env_packages"
-_UNSUPPORTED = ("pip", "conda", "uv", "container", "image_uri")
+_UNSUPPORTED = ("conda", "container", "image_uri")
 
 # driver-side upload cache: abspath -> (signature, pkg_key)
 _upload_cache: Dict[str, Tuple[Tuple, str]] = {}
@@ -128,6 +135,14 @@ def prepare_runtime_env(env: Optional[Dict[str, Any]], gcs) -> Dict[str, Any]:
     for m in env.get("py_modules") or []:
         out.setdefault("py_module_pkgs", []).append(
             m if str(m).startswith("pkg_") else upload_package(gcs, m))
+    reqs = env.get("pip") or env.get("uv")
+    if reqs:
+        if isinstance(reqs, dict):  # reference accepts {"packages": [...]}
+            reqs = reqs.get("packages") or []
+        if not isinstance(reqs, (list, tuple)):
+            raise ValueError("runtime_env pip/uv must be a list of "
+                             "requirement strings")
+        out["pip_requirements"] = sorted(str(r) for r in reqs)
     return out
 
 
@@ -157,6 +172,73 @@ def _extract_package(gcs, key: str, cache_dir: str) -> str:
     return dest
 
 
+def _venv_site_packages(venv_dir: str) -> str:
+    import glob as _glob
+
+    hits = _glob.glob(os.path.join(venv_dir, "lib", "python*",
+                                   "site-packages"))
+    if not hits:
+        raise RuntimeError(f"no site-packages under venv {venv_dir}")
+    return hits[0]
+
+
+def build_pip_venv(requirements: List[str], cache_dir: str) -> str:
+    """Build (or reuse) the virtualenv for an exact requirement set.
+
+    Content-hash-addressed: every worker on the node asking for the
+    same sorted requirement list shares one venv; concurrent builders
+    race benignly (build into a private tmp dir, atomic rename, loser
+    discards). ``uv`` is used when present (reference: uv.py — an
+    order of magnitude faster than pip), else ``python -m venv`` +
+    pip. ``--system-site-packages`` keeps this framework and its deps
+    importable from inside the env, like the reference's pip plugin
+    (reference: _private/runtime_env/pip.py:300 _install_pip_packages).
+
+    Returns the venv's site-packages path.
+    """
+    import shutil
+    import subprocess
+    import tempfile as _tf
+
+    key = "venv_" + hashlib.sha256(
+        "\n".join(requirements).encode()).hexdigest()[:20]
+    dest = os.path.join(cache_dir, key)
+    if os.path.isdir(dest):
+        return _venv_site_packages(dest)
+    os.makedirs(cache_dir, exist_ok=True)
+    tmp = _tf.mkdtemp(prefix=key + ".", dir=cache_dir)
+    try:
+        uv = shutil.which("uv")
+        if uv:
+            subprocess.run(
+                [uv, "venv", "--system-site-packages", "--python",
+                 sys.executable, tmp],
+                check=True, capture_output=True, text=True)
+            install = [uv, "pip", "install", "--python",
+                       os.path.join(tmp, "bin", "python")]
+        else:
+            subprocess.run(
+                [sys.executable, "-m", "venv", "--system-site-packages",
+                 tmp],
+                check=True, capture_output=True, text=True)
+            install = [os.path.join(tmp, "bin", "python"), "-m", "pip",
+                       "install", "--no-input"]
+        proc = subprocess.run(install + list(requirements),
+                              capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"runtime_env pip install failed:\n{proc.stdout}\n"
+                f"{proc.stderr}")
+        try:
+            os.rename(tmp, dest)
+        except OSError:
+            shutil.rmtree(tmp, ignore_errors=True)  # concurrent winner
+    except Exception:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    return _venv_site_packages(dest)
+
+
 def env_hash(env: Dict[str, Any]) -> str:
     import json
 
@@ -174,6 +256,12 @@ def apply_runtime_env(env: Optional[Dict[str, Any]], gcs,
         return
     for k, v in (env.get("env_vars") or {}).items():
         os.environ[k] = v
+    reqs = env.get("pip_requirements")
+    if reqs:
+        sp = build_pip_venv(list(reqs),
+                            os.path.join(cache_dir, "venvs"))
+        if sp not in sys.path:
+            sys.path.insert(0, sp)
     for key in env.get("py_module_pkgs") or []:
         p = _extract_package(gcs, key, cache_dir)
         if p not in sys.path:

@@ -272,6 +272,79 @@ class Dataset:
     def groupby(self, key: str) -> "GroupedData":
         return GroupedData(self, key)
 
+    def join(self, other: "Dataset", on: str, how: str = "inner",
+             num_partitions: Optional[int] = None) -> "Dataset":
+        """Distributed hash join (reference: data/_internal joins via
+        hash shuffle; data/dataset.py Dataset.join). Both sides
+        hash-partition on the key (map tasks), matching partitions join
+        pairwise (one task per bucket) — no driver materialization of
+        either table."""
+        if how not in ("inner", "left", "outer"):
+            raise ValueError(f"unsupported join how={how!r}")
+
+        left_refs = list(self._iter_output_refs())
+        right_refs = list(other._iter_output_refs())
+        k = num_partitions or max(len(left_refs), len(right_refs), 1)
+
+        @ray_tpu.remote(num_returns=k)
+        def _part(block: Block, key: str, k: int):
+            n = block_num_rows(block)
+            if not n:
+                # keep the SCHEMA even with zero rows: a bucket whose
+                # side is empty must still know that side's columns, or
+                # a left/outer join there drops them instead of NaN-ing
+                parts = [{c: v[:0] for c, v in block.items()}
+                         for _ in range(k)]
+            else:
+                vals = np.asarray(block[key])
+                if vals.dtype.kind in "iub":
+                    assign = vals.astype(np.int64) % k
+                else:
+                    from pandas.util import hash_array
+
+                    assign = (hash_array(vals) % k).astype(np.int64)
+                parts = [block_take(block, np.where(assign == j)[0])
+                         for j in range(k)]
+            return parts if k > 1 else parts[0]
+
+        @ray_tpu.remote
+        def _join_bucket(key: str, how: str, n_left: int, *parts):
+            import pandas as pd
+
+            def side_df(side):
+                data = block_concat(
+                    [p for p in side if block_num_rows(p)])
+                return pd.DataFrame(data) if data \
+                    else pd.DataFrame({key: []})
+
+            lefts, rights = parts[:n_left], parts[n_left:]
+            if not any(block_num_rows(p) for p in parts):
+                return {}
+            merged = side_df(lefts).merge(side_df(rights), on=key,
+                                          how=how, suffixes=("", "_right"))
+            # a bucket whose side had ZERO rows lost that side's columns
+            # in the merge — every part still carries its schema (see
+            # _part's zero-row slices), so restore them as NaN to keep
+            # bucket schemas consistent
+            for p in parts:
+                for c in p:
+                    if c not in merged.columns:
+                        merged[c] = np.nan
+            return {c: merged[c].to_numpy() for c in merged.columns}
+
+        left_parts = [_part.remote(r, on, k) for r in left_refs]
+        right_parts = [_part.remote(r, on, k) for r in right_refs]
+        if k == 1:
+            left_parts = [[p] for p in left_parts]
+            right_parts = [[p] for p in right_parts]
+        out_refs = []
+        for j in np.arange(k):
+            bucket_left = [ps[j] for ps in left_parts]
+            bucket_right = [ps[j] for ps in right_parts]
+            out_refs.append(_join_bucket.remote(
+                on, how, len(bucket_left), *bucket_left, *bucket_right))
+        return Dataset(out_refs)
+
     def union(self, *others: "Dataset") -> "Dataset":
         refs = list(self._iter_output_refs())
         for o in others:
@@ -604,6 +677,30 @@ class GroupedData:
 
     def min(self, col: str) -> Dataset:
         return self._agg(lambda g: {f"min({col})": g[col].min()}, "min")
+
+    def std(self, col: str, ddof: int = 1) -> Dataset:
+        return self._agg(
+            lambda g: {f"std({col})": float(np.std(g[col], ddof=ddof))
+                       if block_num_rows(g) > ddof else 0.0}, "std")
+
+    def aggregate(self, **aggs: Tuple[str, str]) -> Dataset:
+        """Multiple named aggregations in ONE shuffle (reference:
+        GroupedData.aggregate): ``aggregate(total=("x", "sum"),
+        hi=("x", "max"))``."""
+        fns = {"sum": lambda a: a.sum(), "mean": lambda a: a.mean(),
+               "min": lambda a: a.min(), "max": lambda a: a.max(),
+               "count": lambda a: len(a),
+               "std": lambda a: float(np.std(a, ddof=1))
+               if len(a) > 1 else 0.0}
+        for name, (col, op) in aggs.items():
+            if op not in fns:
+                raise ValueError(f"unknown aggregation {op!r}")
+
+        def _multi(g: Block) -> Dict[str, Any]:
+            return {name: fns[op](g[col])
+                    for name, (col, op) in aggs.items()}
+
+        return self._agg(_multi, "agg")
 
 
 @ray_tpu.remote

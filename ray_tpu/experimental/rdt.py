@@ -1,0 +1,133 @@
+"""RDT — direct tensor transport between actors, device-aware.
+
+Reference: python/ray/experimental/rdt/collective_tensor_transport.py:34
+and nixl_tensor_transport.py:94 — the reference moves GPU tensors
+actor-to-actor through NCCL collectives or NIXL, bypassing the object
+store's pickle path. The TPU equivalents, in preference order:
+
+1. **In-jit collectives** — tensors that move between devices as part
+   of a sharded computation never leave XLA: ``psum``/``ppermute``
+   over ICI (ray_tpu.util.collective / parallel.*). That is the real
+   TPU device path and needs no transport object at all.
+2. **Same-host, cross-process** (this module): a shared-memory
+   ``DeviceTensorChannel`` — the producer's device array is DMA'd to a
+   pinned host buffer and memcpy'd into shm (no pickle), the consumer
+   maps the same shm and ``jax.device_put``s onto its device. bfloat16
+   rides as a uint16 view (numpy has no bf16 wire type).
+3. **Cross-host**: the chunked object-store pull path (already
+   pickle-free for array payloads via pickle-5 out-of-band buffers) —
+   on real pods, prefer (1): DCN-routed XLA collectives.
+
+``DeviceTensorChannel`` keeps the typed channels' fixed-shape seqlock
+protocol, so hand-off cost is one D2H + one memcpy + one H2D, with
+backpressure from the reader ack.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.experimental.channel import TensorChannel, TensorChannelReader
+
+_BF16_WIRE = "uint16"  # numpy-safe carrier for bfloat16 payloads
+
+
+def _wire_dtype(dtype_str: str) -> Tuple[str, bool]:
+    if dtype_str == "bfloat16":
+        return _BF16_WIRE, True
+    return dtype_str, False
+
+
+def _to_host(arr) -> np.ndarray:
+    """Device array -> contiguous host ndarray without pickle. For jax
+    arrays this is the runtime's D2H DMA; numpy passes through."""
+    try:
+        import jax
+
+        if isinstance(arr, jax.Array):
+            arr = np.asarray(arr)
+    except Exception:  # noqa: BLE001 — jax absent: numpy-only mode
+        pass
+    return np.ascontiguousarray(arr)
+
+
+class DeviceTensorChannel:
+    """Fixed-shape device-tensor slot between two local actors."""
+
+    def __init__(self, shape, dtype: str = "float32",
+                 num_readers: int = 1, name: Optional[str] = None):
+        self.shape = tuple(shape)
+        self.dtype = str(dtype)
+        wire, self._is_bf16 = _wire_dtype(self.dtype)
+        self._ch = TensorChannel(shape, wire, num_readers=num_readers,
+                                 name=name)
+        self.name = self._ch.name
+
+    def write(self, arr, timeout: Optional[float] = 10.0) -> None:
+        host = _to_host(arr)
+        if self._is_bf16:
+            if str(host.dtype) != "bfloat16":
+                raise ValueError(
+                    f"channel carries bfloat16, got {host.dtype}")
+            host = host.view(np.uint16)
+        self._ch.write(host, timeout=timeout)
+
+    def reader(self, reader_index: int = 0,
+               device: Any = None) -> "DeviceTensorReader":
+        return DeviceTensorReader(self.name, self.shape, self.dtype,
+                                  self._ch.num_readers, reader_index,
+                                  device)
+
+    def close(self) -> None:
+        self._ch.close()
+
+    def __reduce__(self):
+        return (_rebuild_channel, (self.name, self.shape, self.dtype,
+                                   self._ch.num_readers))
+
+
+def _rebuild_channel(name, shape, dtype, num_readers):
+    ch = DeviceTensorChannel.__new__(DeviceTensorChannel)
+    ch.shape = tuple(shape)
+    ch.dtype = str(dtype)
+    wire, ch._is_bf16 = _wire_dtype(ch.dtype)
+    ch._ch = TensorChannel(shape, wire, num_readers=num_readers,
+                           name=name, _attach=True)
+    ch.name = name
+    return ch
+
+
+class DeviceTensorReader:
+    """Reads the shm slot and lands the tensor on a device."""
+
+    def __init__(self, name: str, shape, dtype: str, num_readers: int,
+                 reader_index: int, device: Any = None):
+        self.shape = tuple(shape)
+        self.dtype = str(dtype)
+        wire, self._is_bf16 = _wire_dtype(self.dtype)
+        self._rd = TensorChannelReader(name, shape, wire, num_readers,
+                                       reader_index)
+        self.device = device
+
+    def read(self, timeout: Optional[float] = 10.0):
+        """Returns a jax.Array on ``device`` (default: the process's
+        default device); falls back to numpy when jax is unavailable."""
+        host = self._rd.read(timeout=timeout)
+        if self._is_bf16:
+            from ml_dtypes import bfloat16 as _bf16
+
+            host = host.view(_bf16)
+        try:
+            import jax
+
+            dev = self.device or jax.devices()[0]
+            return jax.device_put(host, dev)
+        except ImportError:
+            return host
+
+    def __reduce__(self):
+        return (DeviceTensorReader, (self._rd.name, self.shape,
+                                     self.dtype, self._rd.num_readers,
+                                     self._rd.reader_index, None))

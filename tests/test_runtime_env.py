@@ -21,7 +21,7 @@ class TestMergeAndValidate:
         assert m["working_dir"] == "/j"
 
     def test_unsupported_field_rejected(self, ray_start_regular):
-        @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+        @ray_tpu.remote(runtime_env={"conda": {"dependencies": ["x"]}})
         def f():
             return 1
 
@@ -119,3 +119,90 @@ class TestJobLevelEnv:
             assert ray_tpu.get(g.remote()) == "override"
         finally:
             ray_tpu.shutdown()
+
+
+def _make_wheel(tmp_path, name="rtenv_probe_pkg", version="1.0",
+                value=12345):
+    """Hand-roll a minimal pure-python wheel (no network, no build
+    tooling): a zip with the package and its dist-info."""
+    import base64
+    import hashlib
+    import zipfile
+
+    whl = tmp_path / f"{name}-{version}-py3-none-any.whl"
+    dist = f"{name}-{version}.dist-info"
+    files = {
+        f"{name}/__init__.py": f"VALUE = {value}\n",
+        f"{dist}/METADATA": (
+            f"Metadata-Version: 2.1\nName: {name}\n"
+            f"Version: {version}\n"),
+        f"{dist}/WHEEL": ("Wheel-Version: 1.0\nGenerator: test\n"
+                          "Root-Is-Purelib: true\nTag: py3-none-any\n"),
+    }
+    record_rows = []
+    with zipfile.ZipFile(whl, "w") as zf:
+        for path, content in files.items():
+            data = content.encode()
+            zf.writestr(path, data)
+            digest = base64.urlsafe_b64encode(
+                hashlib.sha256(data).digest()).rstrip(b"=").decode()
+            record_rows.append(f"{path},sha256={digest},{len(data)}")
+        record_rows.append(f"{dist}/RECORD,,")
+        zf.writestr(f"{dist}/RECORD", "\n".join(record_rows) + "\n")
+    return str(whl)
+
+
+class TestPipRuntimeEnv:
+    """VERDICT r4 item 7 (reference: _private/runtime_env/pip.py:300,
+    uv.py): per-env virtualenvs with content-hash caching; a task runs
+    with a package the driver doesn't have."""
+
+    def test_task_runs_with_package_driver_lacks(self, ray_start_regular,
+                                                 tmp_path):
+        whl = _make_wheel(tmp_path)
+        with pytest.raises(ImportError):
+            import rtenv_probe_pkg  # noqa: F401 — driver must NOT have it
+
+        @ray_tpu.remote(runtime_env={"pip": [whl]})
+        def probe():
+            import rtenv_probe_pkg
+
+            return rtenv_probe_pkg.VALUE
+
+        assert ray_tpu.get(probe.remote(), timeout=300) == 12345
+
+    def test_venv_cached_across_tasks(self, ray_start_regular, tmp_path):
+        """Same requirement set → same content hash → the second task
+        reuses the built venv (worker dedication means it may even be
+        the same worker; either way no second install runs — we assert
+        via the venv dir's inode staying identical)."""
+
+        whl = _make_wheel(tmp_path, value=777)
+
+        @ray_tpu.remote(runtime_env={"pip": [whl]})
+        def venv_ino():
+            import os
+            import rtenv_probe_pkg
+
+            d = os.path.dirname(os.path.dirname(
+                rtenv_probe_pkg.__file__))
+            return rtenv_probe_pkg.VALUE, os.stat(d).st_ino
+
+        v1, ino1 = ray_tpu.get(venv_ino.remote(), timeout=300)
+        v2, ino2 = ray_tpu.get(venv_ino.remote(), timeout=300)
+        assert v1 == v2 == 777
+        assert ino1 == ino2
+
+    def test_build_failure_fails_task_not_worker(self, ray_start_regular):
+        @ray_tpu.remote(runtime_env={"pip": ["definitely-not-a-real-pkg-xyz==9.9.9"]})
+        def broken():
+            return 1
+
+        with pytest.raises(Exception, match="pip install failed|RayTaskError|Worker died"):
+            ray_tpu.get(broken.remote(), timeout=300)
+
+        @ray_tpu.remote
+        def ok():
+            return 2
+
+        assert ray_tpu.get(ok.remote(), timeout=120) == 2

@@ -189,3 +189,72 @@ class TestModelServing:
         tokens = np.ones(16, dtype=np.int32)
         out = h.predict.remote(tokens).result(timeout=120)
         assert isinstance(out, int)
+
+
+class TestReplicaSideRejection:
+    """VERDICT r4 item 5 (reference: replica.py:1630
+    handle_request_with_rejection): the replica enforces
+    max_ongoing_requests itself and rejects at capacity; handles retry
+    with backoff on another replica. Two competing handles — which each
+    believe they have the full caller-side budget — must not overload a
+    replica."""
+
+    def test_two_handles_never_exceed_replica_cap(self, serve_cluster):
+        from ray_tpu.serve.controller import get_app_handle
+
+        @serve.deployment(name="capped", num_replicas=2,
+                          max_ongoing_requests=2)
+        class Slow:
+            def __call__(self, x):
+                time.sleep(0.3)
+                return x
+
+        serve.run(Slow.bind(), name="capped")
+        h1 = get_app_handle("capped")
+        h2 = get_app_handle("capped")
+
+        results, errors = [], []
+
+        def _fire(handle, val):
+            try:
+                results.append(handle.remote(val).result(timeout=120))
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+
+        threads = []
+        for i in range(8):
+            for h in (h1, h2):
+                t = threading.Thread(target=_fire, args=(h, i))
+                t.start()
+                threads.append(t)
+        for t in threads:
+            t.join(timeout=180)
+        assert not errors, errors
+        assert len(results) == 16
+        # the replicas' own accounting: peak concurrency never above cap
+        for actor in h1._rs.actors:
+            stats = ray_tpu.get(actor.ongoing_stats.remote(), timeout=30)
+            assert stats["peak"] <= stats["max"], stats
+            assert stats["ongoing"] == 0, stats
+        serve.delete("capped")
+
+    def test_rejection_raises_when_saturated_past_deadline(
+            self, serve_cluster):
+        from ray_tpu.serve.controller import get_app_handle
+
+        @serve.deployment(name="tiny_cap", num_replicas=1,
+                          max_ongoing_requests=1)
+        class Busy:
+            def __call__(self):
+                time.sleep(15.0)
+                return "done"
+
+        serve.run(Busy.bind(), name="tiny_cap")
+        h = get_app_handle("tiny_cap")
+        first = h.remote()
+        time.sleep(1.0)  # let the first request occupy the only slot
+        h2 = get_app_handle("tiny_cap")
+        with pytest.raises(RuntimeError, match="overloaded"):
+            h2.remote().result(timeout=6.0)
+        assert first.result(timeout=90) == "done"
+        serve.delete("tiny_cap")

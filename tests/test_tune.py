@@ -203,3 +203,93 @@ class TestTuner:
             tune_config=tune.TuneConfig(metric="loss", mode="min"),
         ).fit()
         assert grid.get_best_result().config["lr"] == 1.0
+
+
+class TestTpeSearcher:
+    """VERDICT r4 item 4: a native model-based searcher (reference:
+    tune/search/optuna/optuna_search.py:87 — TPE sampler)."""
+
+    @staticmethod
+    def _branin_like(x, y):
+        # deterministic 2-D objective, global minimum 0 at (0.7, -0.3)
+        return (x - 0.7) ** 2 + (y + 0.3) ** 2
+
+    def _run_searcher(self, searcher, budget, seed):
+        import random as _random
+
+        from ray_tpu.tune.search import Domain
+
+        rng = _random.Random(seed)
+        space = {"x": tune.uniform(-2.0, 2.0), "y": tune.uniform(-2.0, 2.0)}
+        best = float("inf")
+        if searcher is None:  # pure random baseline
+            for _ in range(budget):
+                cfg = {k: v.sample(rng) for k, v in space.items()}
+                best = min(best, self._branin_like(cfg["x"], cfg["y"]))
+            return best
+        searcher.set_search_properties("loss", "min", space)
+        for i in range(budget):
+            cfg = searcher.suggest(f"t{i}")
+            loss = self._branin_like(cfg["x"], cfg["y"])
+            searcher.on_trial_complete(f"t{i}", {"loss": loss})
+            best = min(best, loss)
+        return best
+
+    def test_tpe_beats_random_on_2d_objective(self):
+        budget = 60
+        # average across seeds so the comparison tests the model, not
+        # one lucky draw
+        seeds = [0, 1, 2]
+        tpe_best = [
+            self._run_searcher(
+                tune.TpeSearcher(n_startup_trials=10, seed=s),
+                budget, seed=s)
+            for s in seeds
+        ]
+        rnd_best = [self._run_searcher(None, budget, seed=1000 + s)
+                    for s in seeds]
+        assert sum(tpe_best) < sum(rnd_best), (tpe_best, rnd_best)
+        # and the model actually converges near the optimum
+        assert min(tpe_best) < 0.02, tpe_best
+
+    def test_tpe_domains(self):
+        s = tune.TpeSearcher(n_startup_trials=2, seed=0, max_trials=8)
+        s.set_search_properties("loss", "min", {
+            "lr": tune.loguniform(1e-5, 1e-1),
+            "layers": tune.randint(1, 5),
+            "act": tune.choice(["relu", "gelu"]),
+            "batch": tune.quniform(16, 128, 16),
+            "const": 7,
+        })
+        seen = 0
+        for i in range(20):
+            cfg = s.suggest(f"t{i}")
+            if cfg is None:
+                break
+            seen += 1
+            assert 1e-5 <= cfg["lr"] <= 1e-1
+            assert cfg["layers"] in (1, 2, 3, 4)
+            assert cfg["act"] in ("relu", "gelu")
+            assert cfg["batch"] % 16 == 0 and 16 <= cfg["batch"] <= 128
+            assert cfg["const"] == 7
+            s.on_trial_complete(f"t{i}", {"loss": float(i)})
+        assert seen == 8  # max_trials budget enforced
+
+    def test_tpe_in_tuner(self, ray_start_regular):
+        def objective(config):
+            loss = (config["x"] - 0.5) ** 2
+            tune.report({"loss": loss, "training_iteration": 1})
+
+        grid = tune.Tuner(
+            objective,
+            param_space={"x": tune.uniform(-2.0, 2.0)},
+            tune_config=tune.TuneConfig(
+                metric="loss", mode="min", num_samples=14,
+                max_concurrent_trials=1,
+                search_alg=tune.TpeSearcher(n_startup_trials=4, seed=3),
+            ),
+        ).fit()
+        assert len(grid) == 14
+        best = grid.get_best_result()
+        # 14 sequential TPE trials concentrate near x=0.5
+        assert best.metrics["loss"] < 0.3
