@@ -209,22 +209,29 @@ def build_pip_venv(requirements: List[str], cache_dir: str) -> str:
     tmp = _tf.mkdtemp(prefix=key + ".", dir=cache_dir)
     try:
         uv = shutil.which("uv")
+        # bounded: a hung index connection must fail the TASK, not wedge
+        # the env-dedicated worker (and every task queued on its env
+        # hash) forever
+        build_timeout = 600
         if uv:
             subprocess.run(
                 [uv, "venv", "--system-site-packages", "--python",
                  sys.executable, tmp],
-                check=True, capture_output=True, text=True)
+                check=True, capture_output=True, text=True,
+                timeout=build_timeout)
             install = [uv, "pip", "install", "--python",
                        os.path.join(tmp, "bin", "python")]
         else:
             subprocess.run(
                 [sys.executable, "-m", "venv", "--system-site-packages",
                  tmp],
-                check=True, capture_output=True, text=True)
+                check=True, capture_output=True, text=True,
+                timeout=build_timeout)
             install = [os.path.join(tmp, "bin", "python"), "-m", "pip",
                        "install", "--no-input"]
         proc = subprocess.run(install + list(requirements),
-                              capture_output=True, text=True)
+                              capture_output=True, text=True,
+                              timeout=build_timeout)
         if proc.returncode != 0:
             raise RuntimeError(
                 f"runtime_env pip install failed:\n{proc.stdout}\n"

@@ -296,13 +296,16 @@ class Dataset:
                 parts = [{c: v[:0] for c, v in block.items()}
                          for _ in range(k)]
             else:
-                vals = np.asarray(block[key])
-                if vals.dtype.kind in "iub":
-                    assign = vals.astype(np.int64) % k
-                else:
-                    from pandas.util import hash_array
+                from pandas.util import hash_array
 
-                    assign = (hash_array(vals) % k).astype(np.int64)
+                vals = np.asarray(block[key])
+                # canonicalize BEFORE hashing: both sides of the join
+                # must bucket equal keys identically even when their
+                # dtypes differ (int64 5 joining float64 5.0 — common
+                # after parquet/CSV ingestion)
+                if vals.dtype.kind in "iufb":
+                    vals = vals.astype(np.float64)
+                assign = (hash_array(vals) % k).astype(np.int64)
                 parts = [block_take(block, np.where(assign == j)[0])
                          for j in range(k)]
             return parts if k > 1 else parts[0]
@@ -679,9 +682,13 @@ class GroupedData:
         return self._agg(lambda g: {f"min({col})": g[col].min()}, "min")
 
     def std(self, col: str, ddof: int = 1) -> Dataset:
+        # <= ddof rows: dispersion is UNDEFINED, not zero (matching
+        # pandas/numpy NaN semantics — 0.0 would claim perfect
+        # certainty from a single sample)
         return self._agg(
             lambda g: {f"std({col})": float(np.std(g[col], ddof=ddof))
-                       if block_num_rows(g) > ddof else 0.0}, "std")
+                       if block_num_rows(g) > ddof
+                       else float("nan")}, "std")
 
     def aggregate(self, **aggs: Tuple[str, str]) -> Dataset:
         """Multiple named aggregations in ONE shuffle (reference:
@@ -691,7 +698,7 @@ class GroupedData:
                "min": lambda a: a.min(), "max": lambda a: a.max(),
                "count": lambda a: len(a),
                "std": lambda a: float(np.std(a, ddof=1))
-               if len(a) > 1 else 0.0}
+               if len(a) > 1 else float("nan")}
         for name, (col, op) in aggs.items():
             if op not in fns:
                 raise ValueError(f"unknown aggregation {op!r}")

@@ -127,25 +127,33 @@ class _ReplicaSet:
         self.outstanding[idx] += 1
         return idx
 
-    def pick_for_model(self, model_id: str) -> int:
+    def pick_for_model(self, model_id: str,
+                       avoid: Optional[int] = None) -> int:
         """Prefer the replica that already loaded model_id; a COLD model
-        goes to the replica with the fewest models pinned so replica
-        LRUs hold disjoint model sets (reference: multiplex routing
-        balances model placement, not just request load — pure pow-2 on
-        cold models lands several on one replica ~25% of the time and
-        thrashes its LRU)."""
+        goes to the replica with the fewest models pinned — tie-broken
+        by outstanding load — so replica LRUs hold disjoint model sets
+        (reference: multiplex routing balances model placement, not just
+        request load — pure pow-2 on cold models lands several on one
+        replica ~25% of the time and thrashes its LRU). ``avoid`` is the
+        replica that just REJECTED this request: it must not win the
+        re-pick even when its pin count is lowest, or the retry loop
+        would ping-pong against a saturated replica while others idle."""
         with self.lock:
             idx = self.model_affinity.get(model_id)
-            if idx is not None and 0 <= idx < len(self.actors):
+            if idx is not None and 0 <= idx < len(self.actors) \
+                    and idx != avoid:
                 self.outstanding[idx] += 1
                 return idx
             counts = [0] * len(self.actors)
             for i in self.model_affinity.values():
                 if 0 <= i < len(counts):
                     counts[i] += 1
-            fewest = min(counts)
+            cands = [i for i in range(len(self.actors)) if i != avoid] \
+                or list(range(len(self.actors)))
+            best = min((counts[i], self.outstanding[i]) for i in cands)
             idx = random.choice(
-                [i for i, c in enumerate(counts) if c == fewest])
+                [i for i in cands
+                 if (counts[i], self.outstanding[i]) == best])
             self.outstanding[idx] += 1
             self.model_affinity[model_id] = idx
             return idx
@@ -295,16 +303,27 @@ class DeploymentHandle:
             # rejection re-pick goes through the LIVE handle state: a
             # scale-up between attempts routes to the new replicas
             retry=lambda: self._retry_after_rejection(
-                method, args, kwargs, model_id))
+                method, args, kwargs, model_id, rejected_idx=idx))
 
-    def _retry_after_rejection(self, method, args, kwargs, model_id):
+    def _retry_after_rejection(self, method, args, kwargs, model_id,
+                               rejected_idx: Optional[int] = None):
         if model_id:
-            # the model-affinity pin would re-pick the SAME overloaded
-            # replica forever; drop it so pow-2 can route elsewhere
-            # (the new replica cold-loads the model — the right trade
-            # under overload)
-            with self._rs.lock:
-                self._rs.model_affinity.pop(model_id, None)
+            rs = self._rs
+            with rs.lock:
+                # the pin points at the replica that just rejected us —
+                # drop it so the cold path (which excludes that
+                # replica) routes elsewhere; the new replica cold-loads
+                # the model, the right trade under overload
+                if rs.model_affinity.get(model_id) == rejected_idx:
+                    rs.model_affinity.pop(model_id, None)
+            idx = rs.pick_for_model(model_id, avoid=rejected_idx)
+            actor = rs.actors[idx]
+            ref = actor.handle_request_with_rejection.remote(
+                method, args, kwargs, model_id)
+            return DeploymentResponse(
+                ref, on_done=lambda: rs.release(idx),
+                retry=lambda: self._retry_after_rejection(
+                    method, args, kwargs, model_id, rejected_idx=idx))
         return self._call(method, args, kwargs, model_id)
 
     def __reduce__(self):

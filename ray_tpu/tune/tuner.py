@@ -241,7 +241,14 @@ class Tuner:
                 tr = queue.pop(0)
                 if searcher is not None and tr.config is None:
                     cfg = searcher.suggest(tr.trial_id)
-                    if cfg is None:  # searcher budget exhausted
+                    if cfg is None:
+                        # budget exhausted: the trial is RECORDED as
+                        # errored, not silently vanished — the grid's
+                        # length must match num_samples
+                        tr.config = {}
+                        tr.error = ("search_alg exhausted its budget "
+                                    "before this trial")
+                        finished.append(tr)
                         continue
                     tr.config = cfg
                 actor = _launch(tr, tr.restart_ckpt)
