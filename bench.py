@@ -146,6 +146,20 @@ def _run_allreduce() -> None:
                 col.allreduce(self.arr, group_name="bench")
             return _t.perf_counter() - t0
 
+        def step_small(self, iters: int) -> float:
+            import time as _t
+
+            import numpy as _np
+
+            from ray_tpu.util import collective as col
+
+            small = _np.ones(16384, _np.float32)  # 64 KiB -> channel path
+            col.allreduce(small, group_name="bench")  # channel setup
+            t0 = _t.perf_counter()
+            for _ in range(iters):
+                col.allreduce(small, group_name="bench")
+            return _t.perf_counter() - t0
+
     ranks = [Rank.remote(i, 2) for i in range(2)]
     ray_tpu.get([r.step.remote(1) for r in ranks])  # warm up
     iters = 10
@@ -153,6 +167,10 @@ def _run_allreduce() -> None:
     dt = max(times)
     out["objstore_allreduce_2rank_gb_s"] = round(
         8 * (1 << 20) * iters / dt / 1e9, 3)
+    # small-op latency regime: the shared-memory channel data plane
+    small_iters = 300
+    times = ray_tpu.get([r.step_small.remote(small_iters) for r in ranks])
+    out["allreduce_64kb_2rank_ops_s"] = round(small_iters / max(times), 1)
     ray_tpu.shutdown()
     print("ALLREDUCE_JSON " + json.dumps(out))
 

@@ -102,7 +102,12 @@ class ChannelReader(_Endpoint):
     def _await_next(self, deadline: Optional[float],
                     timeout: Optional[float]) -> int:
         """Spin until a stable (even) sequence newer than the last-read
-        one exists."""
+        one exists. Pure spins first (sub-transfer latency), then
+        progressive naps capped at 0.4 ms — on CPU-starved hosts an
+        unbounded busy-poll steals the very cycles the writer needs,
+        while a high nap cap overshoots fast writers."""
+        spins = 0
+        nap = 0.0001
         while True:
             seq = self._seq
             if seq > self._last and seq % 2 == 0:
@@ -110,7 +115,10 @@ class ChannelReader(_Endpoint):
             if deadline is not None and time.monotonic() > deadline:
                 raise ChannelTimeoutError(
                     f"no write within {timeout}s (seq={seq})")
-            time.sleep(0.0001)
+            spins += 1
+            if spins > 50:
+                time.sleep(nap)
+                nap = min(nap * 2, 0.0004)
 
     def read(self, timeout: Optional[float] = 10.0) -> Any:
         """Block until the NEXT value is written; acknowledge it."""
@@ -150,14 +158,20 @@ class Channel(_Endpoint):
                          create=not _attach)
 
     def _await_acks(self, seq: int, timeout: Optional[float]) -> None:
-        """Spin until every reader consumed the previous value."""
+        """Spin until every reader consumed the previous value (same
+        spin-then-nap rationale as ChannelReader._await_next)."""
         deadline = None if timeout is None else time.monotonic() + timeout
+        spins = 0
+        nap = 0.0001
         while any(self._get(16 + 8 * i) < seq
                   for i in range(self.num_readers)):
             if deadline is not None and time.monotonic() > deadline:
                 raise ChannelTimeoutError(
                     f"readers did not consume value {seq} within {timeout}s")
-            time.sleep(0.0001)
+            spins += 1
+            if spins > 50:
+                time.sleep(nap)
+                nap = min(nap * 2, 0.0004)
 
     def write(self, value: Any, timeout: Optional[float] = 10.0) -> None:
         data = pickle.dumps(value, protocol=5)
