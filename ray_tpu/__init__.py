@@ -52,6 +52,7 @@ from ray_tpu.actor import ActorClass, ActorHandle, method
 from ray_tpu.remote_function import RemoteFunction
 from ray_tpu.runtime_context import get_runtime_context
 from ray_tpu import exceptions  # noqa: F401
+from ray_tpu import observability  # noqa: F401 — event bus + tracing
 
 _ALLOWED_TASK_OPTIONS = {
     "num_returns",
@@ -113,6 +114,7 @@ def remote(*args, **kwargs):
 __all__ = [
     "__version__",
     "init",
+    "observability",
     "timeline",
     "tpu_profile",
     "start_tpu_profile",

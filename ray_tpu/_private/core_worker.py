@@ -62,8 +62,24 @@ from ray_tpu.exceptions import (
     TaskCancelledError,
     WorkerCrashedError,
 )
+from ray_tpu.observability import events as obs_events
+from ray_tpu.observability import tracing as obs_tracing
 
 logger = logging.getLogger(__name__)
+
+
+def _task_latency_histogram():
+    """Submit→completion latency histogram (caller-side), merged into the
+    util/metrics.py scrape endpoint. Import stays lazy so the metrics
+    pusher thread only exists in processes that complete tasks."""
+    from ray_tpu.util.metrics import get_histogram
+
+    return get_histogram(
+        "ray_tpu_task_latency_s",
+        description="Task submit-to-completion latency",
+        boundaries=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0),
+        tag_keys=("kind",),
+    )
 
 
 class _InfeasibleStrategyError(Exception):
@@ -266,6 +282,7 @@ class _ActorDispatcher:
                         "addr": addr,
                         "method": payload.get("method_name", "actor_task"),
                         "ts": now,
+                        "submit_ts": payload.get("submit_ts", 0.0),
                     }
             try:
                 reply = await get_client(addr).acall(
@@ -447,10 +464,11 @@ class _ActorStateHub:
 
     async def _loop(self) -> None:
         while self._events and not self.core._shutdown:
+            after = self._seq
             try:
                 rep = await self.core.gcs.acall(
                     "Subscribe", channel="actor_state",
-                    after_seq=self._seq, timeout_s=30.0, timeout=45)
+                    after_seq=after, timeout_s=30.0, timeout=45)
             except asyncio.CancelledError:
                 raise
             except Exception:  # noqa: BLE001 — GCS blip/restart
@@ -463,6 +481,16 @@ class _ActorStateHub:
                         ev.set()
                 continue
             self._seq = rep.get("next_seq", self._seq)
+            if after < rep.get("dropped_floor", 0):
+                # the publisher's ring evicted events past our cursor:
+                # anything between after and the floor is gone, and a
+                # missed DEAD/restart transition would hang its watcher's
+                # pending tasks forever — wake EVERY watcher so each
+                # re-fetches its actor's state (changed=True path)
+                self._seq = max(self._seq, rep["dropped_floor"])
+                for s in self._events.values():
+                    for ev in s:
+                        ev.set()
             for _seqno, aid, _payload in rep.get("events", ()):
                 for ev in self._events.get(aid, ()):
                     ev.set()
@@ -619,6 +647,10 @@ class CoreWorker(CoreRuntime):
             self._task_events.append(ev)
             if len(self._task_events) > 10_000:
                 del self._task_events[:5_000]
+        if obs_tracing.active():
+            # mirror lifecycle transitions onto the event bus so the
+            # flight recorder shows them interleaved with spans
+            obs_events.record_event("task_state", **ev)
 
     def _task_event_flush_loop(self) -> None:
         while not self._shutdown:
@@ -943,6 +975,10 @@ class CoreWorker(CoreRuntime):
         return ObjectRef(oid, owner_addr=self.address)
 
     def put_serialized(self, oid: ObjectID, data: bytes) -> None:
+        if obs_tracing.active():
+            obs_events.record_event(
+                "object_put", size=len(data), job_id=self.job_id.hex(),
+                inline=len(data) <= config.object_store_inline_max_bytes)
         if len(data) <= config.object_store_inline_max_bytes:
             self.memory_store.put(oid, ("inline", data))
         else:
@@ -1071,6 +1107,10 @@ class CoreWorker(CoreRuntime):
     def _deserialize_entry(self, oid: ObjectID, entry_value: tuple) -> Any:
         kind = entry_value[0]
         if kind == "inline":
+            if obs_tracing.active():
+                obs_events.record_event(
+                    "object_get", size=len(entry_value[1]),
+                    job_id=self.job_id.hex(), inline=True)
             val = deserialize(entry_value[1])
         else:  # plasma
             node_id = entry_value[1]
@@ -1094,6 +1134,10 @@ class CoreWorker(CoreRuntime):
             [view] = self.plasma.get([oid], timeout_ms=int(config.rpc_call_timeout_s * 1000))
             if view is None:
                 raise ObjectLostError(f"object {oid.hex()} not in local store")
+            if obs_tracing.active():
+                obs_events.record_event(
+                    "object_get", size=len(view),
+                    job_id=self.job_id.hex(), inline=False)
             # the get-pin lives exactly as long as the deserialized value:
             # released when the last zero-copy array viewing the region is
             # collected (so long-lived refs don't wedge the store full)
@@ -1445,6 +1489,11 @@ class CoreWorker(CoreRuntime):
         spec.is_streaming_generator = streaming
         spec.kwargs_map = ser_kwargs  # type: ignore[attr-defined]
         spec.contained_refs = contained  # type: ignore[attr-defined]
+        # trace propagation: the caller's active sampled span (or None —
+        # one thread-local read when tracing is idle) rides the spec so
+        # the executor's span parents here across the process boundary
+        spec.trace_ctx = obs_tracing.for_outbound()  # type: ignore[attr-defined]
+        spec.submit_ts = time.time()  # type: ignore[attr-defined]
         return_ids = spec.return_ids()
         for oid in return_ids:
             self._ref_counter().add_owned_object(oid, pending_creation=True)
@@ -1916,6 +1965,8 @@ class CoreWorker(CoreRuntime):
             "caller_addr": spec.caller_addr,
             "retry_exceptions": spec.retry_exceptions,
             "attempt_number": spec.attempt_number,
+            "trace_ctx": getattr(spec, "trace_ctx", None),
+            "submit_ts": getattr(spec, "submit_ts", 0.0),
         }
 
     def _claim_push_completion(self, task_id: TaskID,
@@ -2093,6 +2144,11 @@ class CoreWorker(CoreRuntime):
             self._record_task_event(
                 spec.task_id, spec.function_descriptor.repr_name,
                 "FAILED" if retriable_error else "FINISHED")
+            submit_ts = getattr(spec, "submit_ts", 0.0)
+            if submit_ts:
+                _task_latency_histogram().observe(
+                    max(0.0, time.time() - submit_ts),
+                    tags={"kind": "task"})
 
     # ==================================================================
     # Object recovery (reference: object_recovery_manager.h:41 — the owner
@@ -2369,6 +2425,8 @@ class CoreWorker(CoreRuntime):
                 for k, a in ser_kwargs.items()
             },
             "caller_addr": self.address,
+            "trace_ctx": obs_tracing.for_outbound(),
+            "submit_ts": time.time(),
         }
         gen = self._register_stream(task_id) if streaming else None
         self._record_task_event(task_id, method_name, "SUBMITTED", kind="actor_task")
@@ -2424,6 +2482,10 @@ class CoreWorker(CoreRuntime):
         self._record_task_event(
             tid, info.get("method", "actor_task"),
             "FAILED" if failed else "FINISHED", kind="actor_task")
+        if info.get("submit_ts"):
+            _task_latency_histogram().observe(
+                max(0.0, time.time() - info["submit_ts"]),
+                tags={"kind": "actor_task"})
         return {"ok": True}
 
     # ==================================================================

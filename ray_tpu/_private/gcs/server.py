@@ -126,6 +126,15 @@ class GcsServer:
         self.pubsub: Dict[str, Any] = {}
         self._pubsub_seq = 0
         self._pubsub_waiters: Any = None  # asyncio.Condition, lazy
+        # channel -> seq of the NEWEST event the bounded ring evicted; a
+        # subscriber whose cursor is below this floor has a gap it can
+        # never replay and must resync (Subscribe returns the floor)
+        self.pubsub_dropped: Dict[str, int] = {}
+        # event bus + trace aggregation (reference: GcsTaskManager-style
+        # bounded history, see observability/aggregator.py)
+        from ray_tpu.observability.aggregator import EventAggregator
+
+        self.cluster_events = EventAggregator()
         # lease, not a latch: the autoscaler re-asserts every reconcile
         # round; if it dies, the flag expires and raylets fall back to
         # fail-fast infeasible errors instead of queueing forever
@@ -909,7 +918,16 @@ class GcsServer:
             if node_id is None:
                 await asyncio.sleep(0.2)
                 continue
+            gate_wait_from = time.monotonic()
             async with self._creation_gate():
+                # The schedule deadline must budget CREATION time, not
+                # time spent QUEUED behind other creations at the gate:
+                # in a large burst with slow __init__, tail actors sit at
+                # the gate for most of the 300s window and were marked
+                # DEAD on their first transient retry. Credit the queue
+                # wait back (reference: the per-node in-flight lease
+                # bound applies before the scheduling timer starts).
+                deadline += time.monotonic() - gate_wait_from
                 if actor.state == "DEAD":  # killed while queued at gate
                     return
                 outcome = await self._try_create_once(actor, node_id)
@@ -1089,6 +1107,18 @@ class GcsServer:
         return {"ok": True}
 
     async def _handle_actor_failure(self, actor: ActorInfo, cause: str) -> None:
+        # actor restarts/deaths are first-class bus events (the GCS is
+        # the aggregator, so it appends directly — no RPC to itself)
+        self.cluster_events.add([{
+            "type": "actor_restart",
+            "ts": time.time(),
+            "actor_id": actor.actor_id,
+            "job_id": actor.job_id,
+            "num_restarts": actor.num_restarts,
+            "will_restart": actor.num_restarts < actor.max_restarts
+            or actor.max_restarts == -1,
+            "cause": cause,
+        }])
         if actor.num_restarts < actor.max_restarts or actor.max_restarts == -1:
             actor.num_restarts += 1
             actor.state = "RESTARTING"
@@ -1319,6 +1349,30 @@ class GcsServer:
         ]
         return out[-limit:]
 
+    # -- event bus + tracing (observability/: workers push typed-event
+    # batches; spans are indexed per job for GetTrace) ------------------
+    async def ReportClusterEvents(self, events: List[dict]) -> dict:
+        self.cluster_events.add(events)
+        return {"ok": True}
+
+    async def ListClusterEvents(self, etype: Optional[str] = None,
+                                job_id: Optional[str] = None,
+                                limit: int = 1000) -> List[dict]:
+        return self.cluster_events.list_events(etype=etype, job_id=job_id,
+                                               limit=limit)
+
+    async def GetTrace(self, job_id: str) -> dict:
+        return self.cluster_events.get_trace(job_id)
+
+    async def ReportNodeStats(self, node_id: str, stats: dict) -> dict:
+        """Per-node reporter samples from the dashboard agents
+        (reference: dashboard/agent.py reporter module)."""
+        self.cluster_events.set_node_stats(node_id, stats)
+        return {"ok": True}
+
+    async def ListNodeStats(self) -> List[dict]:
+        return self.cluster_events.list_node_stats()
+
     async def PublishLogs(self, node_id: str, worker_id: str,
                           lines: List[str]) -> dict:
         for ln in lines:
@@ -1361,9 +1415,12 @@ class GcsServer:
         from collections import deque as _dq
 
         self._pubsub_seq += 1
-        self.pubsub.setdefault(channel, _dq(maxlen=10000)).append(
-            (self._pubsub_seq, key, payload)
-        )
+        q = self.pubsub.setdefault(channel, _dq(maxlen=10000))
+        if q.maxlen is not None and len(q) == q.maxlen:
+            # the append below evicts q[0]: remember its seq as the
+            # channel's dropped floor for gap detection in Subscribe
+            self.pubsub_dropped[channel] = q[0][0]
+        q.append((self._pubsub_seq, key, payload))
 
     def _publish_and_wake(self, channel: str, key: str, payload: Any = None) -> None:
         self._publish(channel, key, payload)
@@ -1387,12 +1444,15 @@ class GcsServer:
         async with cv:
             while True:
                 q = self.pubsub.get(channel)
+                floor = self.pubsub_dropped.get(channel, 0)
                 events = [e for e in (q or ()) if e[0] > after_seq]
                 if events:
-                    return {"events": events, "next_seq": events[-1][0]}
+                    return {"events": events, "next_seq": events[-1][0],
+                            "dropped_floor": floor}
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    return {"events": [], "next_seq": after_seq}
+                    return {"events": [], "next_seq": after_seq,
+                            "dropped_floor": floor}
                 try:
                     await asyncio.wait_for(cv.wait(), timeout=remaining)
                 except asyncio.TimeoutError:
@@ -1478,11 +1538,13 @@ class GcsServer:
                         cum = 0
                         for bound, cnt in zip(ent["bounds"], s["buckets"]):
                             cum += cnt
+                            le = 'le="%s"' % bound
                             ls.append(
-                                f"{name}_bucket{fmt_tags(tags, f'le=\"{bound}\"')} {cum}"
+                                f"{name}_bucket{fmt_tags(tags, le)} {cum}"
                             )
+                        inf = 'le="+Inf"'
                         ls.append(
-                            f"{name}_bucket{fmt_tags(tags, 'le=\"+Inf\"')} {s['count']}"
+                            f"{name}_bucket{fmt_tags(tags, inf)} {s['count']}"
                         )
                         ls.append(f"{name}_sum{fmt_tags(tags)} {s['sum']}")
                         ls.append(f"{name}_count{fmt_tags(tags)} {s['count']}")

@@ -15,6 +15,7 @@ from __future__ import annotations
 import io
 import pickle
 import struct
+import sys
 import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -121,6 +122,13 @@ def serialize_into(value: Any, alloc: Callable[[int], memoryview]) -> memoryview
     return mv
 
 
+# Python-level buffer protocol (PEP 688 ``__buffer__``) only exists on
+# 3.12+. Earlier interpreters can't hand consumers like np.frombuffer a
+# trackable zero-copy wrapper, so they copy out-of-band buffers and
+# release the store pin immediately (deserialize() below).
+_HAS_PEP688 = sys.version_info >= (3, 12)
+
+
 class _TrackedBuffer:
     """Buffer-protocol wrapper (PEP 688) around a shared-memory slice.
 
@@ -173,7 +181,16 @@ def deserialize(data: "bytes | memoryview", release_cb: Optional[Callable] = Non
             (blen,) = struct.unpack_from("<Q", mv, off)
             off += 8
             sl = mv[off : off + blen]  # zero-copy view
-            buffers.append(_TrackedBuffer(sl, shared) if release_cb else sl)
+            if release_cb is None:
+                buffers.append(sl)
+            elif _HAS_PEP688:
+                buffers.append(_TrackedBuffer(sl, shared))
+            else:
+                # pre-3.12: no Python-visible buffer protocol, so a
+                # tracked zero-copy wrapper is invisible to consumers
+                # (np.frombuffer raises). Copy the slice; the pin then
+                # releases in the finally below instead of at value GC.
+                buffers.append(bytes(sl))
             off += blen
         return pickle.loads(
             bytes(meta) if isinstance(meta, memoryview) else meta, buffers=buffers

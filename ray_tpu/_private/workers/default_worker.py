@@ -34,8 +34,25 @@ from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.rpc import RpcClient, get_client
 from ray_tpu._private.serialization import deserialize, loads_function, serialize
 from ray_tpu.exceptions import RayActorError, RayTaskError
+from ray_tpu.observability import events as obs_events
+from ray_tpu.observability import tracing as obs_tracing
 
 logger = logging.getLogger("ray_tpu.worker")
+
+
+def _queue_wait_histogram():
+    """Submit→execution-start wait (the scheduling+lease+dispatch part
+    of task latency), exposed on the Prometheus scrape next to
+    ray_tpu_task_latency_s. Wall-clock across processes — exact on one
+    host, NTP-bounded across hosts."""
+    from ray_tpu.util.metrics import get_histogram
+
+    return get_histogram(
+        "ray_tpu_task_queue_wait_s",
+        description="Task submit-to-execution-start wait",
+        boundaries=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0),
+        tag_keys=("kind",),
+    )
 
 
 def _ray_call_shim(instance, fn, *args, **kwargs):
@@ -158,6 +175,8 @@ class _ActorRunner:
                 payload["method_name"],
                 tuple(payload["caller_addr"]),
                 actor_id=ActorID.from_hex(payload["actor_id"]),
+                trace_ctx=payload.get("trace_ctx"),
+                submit_ts=payload.get("submit_ts", 0.0),
             )
         else:
             result = _execute_callable(
@@ -169,6 +188,8 @@ class _ActorRunner:
                 payload["method_name"],
                 actor_id=ActorID.from_hex(payload["actor_id"]),
                 caller_addr=tuple(payload["caller_addr"]),
+                trace_ctx=payload.get("trace_ctx"),
+                submit_ts=payload.get("submit_ts", 0.0),
             )
         task_bin = payload["task_id"]
         with self.lock:
@@ -301,24 +322,54 @@ def _execute_callable(
     name: str,
     actor_id: Optional[ActorID] = None,
     caller_addr: Optional[Tuple[str, int]] = None,
+    trace_ctx=None,
+    submit_ts: float = 0.0,
 ) -> dict:
-    """Run user code; package returns (inline small / shared-memory big)."""
+    """Run user code; package returns (inline small / shared-memory big).
+
+    The propagated trace context is activated for the WHOLE body — not
+    just the user-code span — so the worker-side bus gates record the
+    RUNNING transition and result-packaging object events too."""
+    with obs_tracing.activated(trace_ctx):
+        return _execute_callable_body(
+            fn, packed_args, packed_kwargs, num_returns, task_id, name,
+            actor_id, caller_addr, submit_ts)
+
+
+def _execute_callable_body(
+    fn,
+    packed_args: List[dict],
+    packed_kwargs: Dict[str, dict],
+    num_returns: int,
+    task_id: TaskID,
+    name: str,
+    actor_id: Optional[ActorID],
+    caller_addr: Optional[Tuple[str, int]],
+    submit_ts: float,
+) -> dict:
     from ray_tpu._private.serialization import collect_object_refs
 
+    kind = "actor_task" if actor_id else "task"
     w = worker_mod.global_worker
     w.set_task_context(task_id, actor_id)
     # execution start: gives the timeline its queued-vs-running split
     # (reference: task_event_buffer.h RUNNING state transition)
     try:
-        w.core._record_task_event(
-            task_id, name, "RUNNING",
-            kind="actor_task" if actor_id else "task")
+        w.core._record_task_event(task_id, name, "RUNNING", kind=kind)
+        if submit_ts:
+            _queue_wait_histogram().observe(
+                max(0.0, time.time() - submit_ts), tags={"kind": kind})
     except Exception:  # noqa: BLE001
         pass
     all_borrows: List[tuple] = []  # every AddBorrower sent for this task
     try:
         args, kwargs = _resolve_args(packed_args, packed_kwargs)
-        result = fn(args, kwargs)
+        # the active (propagated) context makes this execution a child
+        # span of the caller's active span (cross-process parenting);
+        # untraced tasks fall straight through
+        with obs_tracing.span(
+                name, kind=kind, attrs={"task_id": task_id.hex()}):
+            result = fn(args, kwargs)
         if num_returns == 1:
             values = [result]
         else:
@@ -362,6 +413,13 @@ def _execute_callable(
             else:
                 oid = ObjectID.from_index(task_id, i + 1)
                 w.core._plasma_put_with_backpressure(oid, data)
+                # big returns bypass put_serialized, so the bus event is
+                # recorded here (executor-side, gated on the activated
+                # trace context like every worker event)
+                if obs_tracing.active():
+                    obs_events.record_event(
+                        "object_put", size=len(data),
+                        job_id=w.core.job_id.hex(), inline=False)
                 returns.append(
                     {"kind": "plasma", "node_id": w.core.node_id, "borrows": borrows}
                 )
@@ -390,6 +448,8 @@ def _execute_streaming(
     name: str,
     caller_addr: Tuple[str, int],
     actor_id: Optional[ActorID] = None,
+    trace_ctx=None,
+    submit_ts: float = 0.0,
 ) -> dict:
     """Run a generator task, pushing one StreamingYield per value to the
     caller as it is produced (reference: task_manager.cc:778 generator
@@ -397,44 +457,61 @@ def _execute_streaming(
     does not advance until the caller has registered the previous item."""
     w = worker_mod.global_worker
     w.set_task_context(task_id, actor_id)
+    if submit_ts:
+        try:
+            _queue_wait_histogram().observe(
+                max(0.0, time.time() - submit_ts),
+                tags={"kind": "actor_task" if actor_id else "task"})
+        except Exception:  # noqa: BLE001
+            pass
     client = get_client(tuple(caller_addr))
     idx = 0
     try:
         args, kwargs = _resolve_args(packed_args, packed_kwargs)
-        for value in fn(*args, **kwargs):
-            data = serialize(value)
-            if len(data) <= config.object_store_inline_max_bytes:
-                rep = client.call(
-                    "StreamingYield", task_id_bin=task_id.binary(), index=idx,
-                    kind="inline", data=data, timeout=60,
-                )
-            else:
-                oid = ObjectID.from_index(task_id, idx + 1)
-                w.core._plasma_put_with_backpressure(oid, data)
-                rep = client.call(
-                    "StreamingYield", task_id_bin=task_id.binary(), index=idx,
-                    kind="plasma", node_id=w.core.node_id, timeout=60,
-                )
-            if not (rep or {}).get("ok", True):
-                break  # consumer abandoned the stream — stop producing
-            idx += 1
-            # consumer backpressure: pause while the un-consumed buffer on
-            # the caller is deep (reference: generator_backpressure_num_
-            # objects); the registration ack alone doesn't bound it
-            limit = config.streaming_generator_buffer_size
-            while (rep or {}).get("pending", 0) >= limit:
-                time.sleep(0.02)
-                try:
+        with obs_tracing.inbound_span(
+                trace_ctx, name=name,
+                kind="actor_task" if actor_id else "task",
+                attrs={"task_id": task_id.hex(), "streaming": True}):
+            for value in fn(*args, **kwargs):
+                data = serialize(value)
+                if len(data) <= config.object_store_inline_max_bytes:
                     rep = client.call(
-                        "StreamingCredit", task_id_bin=task_id.binary(), timeout=30
+                        "StreamingYield", task_id_bin=task_id.binary(),
+                        index=idx, kind="inline", data=data, timeout=60,
                     )
-                except Exception:  # noqa: BLE001
+                else:
+                    oid = ObjectID.from_index(task_id, idx + 1)
+                    w.core._plasma_put_with_backpressure(oid, data)
+                    if obs_tracing.active():
+                        obs_events.record_event(
+                            "object_put", size=len(data),
+                            job_id=w.core.job_id.hex(), inline=False)
+                    rep = client.call(
+                        "StreamingYield", task_id_bin=task_id.binary(),
+                        index=idx, kind="plasma", node_id=w.core.node_id,
+                        timeout=60,
+                    )
+                if not (rep or {}).get("ok", True):
+                    break  # consumer abandoned the stream — stop producing
+                idx += 1
+                # consumer backpressure: pause while the un-consumed buffer
+                # on the caller is deep (reference: generator_backpressure_
+                # num_objects); the registration ack alone doesn't bound it
+                limit = config.streaming_generator_buffer_size
+                while (rep or {}).get("pending", 0) >= limit:
+                    time.sleep(0.02)
+                    try:
+                        rep = client.call(
+                            "StreamingCredit", task_id_bin=task_id.binary(),
+                            timeout=30,
+                        )
+                    except Exception:  # noqa: BLE001
+                        break
+                    if not rep.get("ok", True):
+                        rep = {"ok": False}
+                        break
+                if not (rep or {}).get("ok", True):
                     break
-                if not rep.get("ok", True):
-                    rep = {"ok": False}
-                    break
-            if not (rep or {}).get("ok", True):
-                break
         done = {"count": idx, "error": None}
     except BaseException as e:  # noqa: BLE001
         tb = traceback.format_exc()
@@ -596,6 +673,8 @@ class WorkerServer:
                 TaskID(spec_payload["task_id"]),
                 spec_payload["function_name"],
                 tuple(caller_addr),
+                trace_ctx=spec_payload.get("trace_ctx"),
+                submit_ts=spec_payload.get("submit_ts", 0.0),
             )
             return fut.result()
         task_bin = spec_payload["task_id"]
@@ -613,6 +692,8 @@ class WorkerServer:
                     spec_payload["function_name"],
                     None,
                     tuple(caller_addr) if caller_addr else None,
+                    trace_ctx=spec_payload.get("trace_ctx"),
+                    submit_ts=spec_payload.get("submit_ts", 0.0),
                 )
             finally:
                 with self._cancel_lock:

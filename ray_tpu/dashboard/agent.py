@@ -39,20 +39,43 @@ def _json_bytes(obj) -> bytes:
 
 
 class NodeAgent:
-    """HTTP endpoint for one node's local observability."""
+    """HTTP endpoint for one node's local observability, plus a reporter
+    loop shipping periodic samples to the head (reference:
+    dashboard/agent.py's reporter module — the head reads fresh per-node
+    stats without a fan-out poll at query time)."""
+
+    REPORT_PERIOD_S = 2.0
 
     def __init__(self, raylet, host: str = "127.0.0.1", port: int = 0):
         self.raylet = raylet
         self.host = host
         self.port = port
         self._server: Optional[asyncio.AbstractServer] = None
+        self._reporter_task: Optional[asyncio.Task] = None
+        self._closed = False
 
     async def start(self) -> Tuple[str, int]:
         self._server = await asyncio.start_server(
             self._on_client, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
+        self._reporter_task = asyncio.ensure_future(self._reporter_loop())
         logger.info("node agent on :%d", self.port)
         return self.host, self.port
+
+    async def _reporter_loop(self) -> None:
+        """Push this node's stats to the GCS aggregator; the head serves
+        them from /api/v0/node_stats (and `rstate.list_node_stats()`)."""
+        while not self._closed:
+            await asyncio.sleep(self.REPORT_PERIOD_S)
+            gcs = getattr(self.raylet, "gcs", None)
+            if gcs is None:
+                continue
+            try:
+                await gcs.acall(
+                    "ReportNodeStats", node_id=self.raylet.node_id,
+                    stats=self._stats(), timeout=10)
+            except Exception:  # noqa: BLE001 — reporting is best-effort
+                pass
 
     async def _on_client(self, reader: asyncio.StreamReader,
                          writer: asyncio.StreamWriter) -> None:
@@ -106,7 +129,7 @@ class NodeAgent:
         import psutil
 
         vm = psutil.virtual_memory()
-        return {
+        out = {
             "node_id": self.raylet.node_id,
             "cpu_percent": psutil.cpu_percent(interval=None),
             "mem_total": vm.total,
@@ -115,6 +138,17 @@ class NodeAgent:
             "num_leases": len(self.raylet.leases),
             "num_oom_kills": self.raylet.num_oom_kills,
         }
+        # object-store fill (the store daemon's own accounting)
+        store = getattr(self.raylet, "store", None)
+        if store is not None:
+            try:
+                m = store.metrics()
+                out["store_capacity"] = m.get("capacity", 0)
+                out["store_allocated"] = m.get("allocated", 0)
+                out["store_num_objects"] = m.get("num_objects", 0)
+            except Exception:  # noqa: BLE001 — store busy/restarting
+                pass
+        return out
 
     def _log_index(self) -> dict:
         d = self.raylet.session_dir
@@ -146,5 +180,8 @@ class NodeAgent:
         return "200 OK", _json_bytes({"name": name, "lines": tail})
 
     def close(self) -> None:
+        self._closed = True
+        if self._reporter_task is not None:
+            self._reporter_task.cancel()
         if self._server is not None:
             self._server.close()

@@ -17,6 +17,9 @@ Routes:
     GET  /api/jobs/<id>/logs   {"logs": "..."}
     GET  /api/tasks            recent task events
     GET  /api/cluster_status   resources + demand summary
+    GET  /api/v0/events        cluster event bus (observability/)
+    GET  /api/v0/traces/<job>  a job's span tree (distributed tracing)
+    GET  /api/v0/node_stats    per-node reporter samples
 """
 
 from __future__ import annotations
@@ -133,6 +136,19 @@ class DashboardHead:
             if method == "GET" and path == "/api/cluster_status":
                 return 200, "application/json", _json_bytes(
                     self._gcs().call("GetClusterDemand", timeout=10))
+            # observability subsystem (event bus + traces + node stats)
+            if method == "GET" and path == "/api/v0/events":
+                return 200, "application/json", _json_bytes(
+                    self._gcs().call("ListClusterEvents", limit=2000,
+                                     timeout=10))
+            if method == "GET" and path == "/api/v0/node_stats":
+                return 200, "application/json", _json_bytes(
+                    self._gcs().call("ListNodeStats", timeout=10))
+            if method == "GET" and path.startswith("/api/v0/traces/"):
+                job_id = path[len("/api/v0/traces/"):]
+                return 200, "application/json", _json_bytes(
+                    self._gcs().call("GetTrace", job_id=job_id,
+                                     timeout=10))
             if path == "/api/jobs":
                 if method == "GET":
                     return 200, "application/json", _json_bytes(

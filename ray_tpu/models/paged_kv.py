@@ -57,6 +57,12 @@ from ray_tpu.models.decoding import (
 from ray_tpu.models.transformer import TransformerConfig
 
 
+class KVPoolExhausted(RuntimeError):
+    """No free pages. A RuntimeError subclass so existing callers that
+    catch the old bare RuntimeError keep working; the batcher's admit
+    path catches THIS to requeue instead of failing the request."""
+
+
 class PagedKV:
     """Host-side page bookkeeping: refcounts, free list, prefix map."""
 
@@ -79,7 +85,7 @@ class PagedKV:
         """Pop the least-recently-freed page, invalidating whatever
         prefix entry still pointed at its old content."""
         if not self.free:
-            raise RuntimeError("KV pool exhausted")
+            raise KVPoolExhausted("KV pool exhausted")
         page, _ = self.free.popitem(last=False)
         old_key = self.page_key.pop(page, None)
         if old_key is not None and self.prefix_map.get(old_key) == page:
@@ -379,9 +385,28 @@ class PagedBatcher:
                 self._admit_one(req, slot)
             except Exception as e:  # noqa: BLE001
                 self._free_slots.append(slot)
+                # _admit_one grows req.pages INCREMENTALLY (reused-prefix
+                # increfs first, then each fresh alloc as it happens), so
+                # this decref sweep releases everything a partial admit
+                # acquired — no page leaks on pool exhaustion mid-admit
                 for page in req.pages:
                     self.kv.decref(page)
                 req.pages = []
+                never_fits = (len(req.tokens) // self.page_size + 1
+                              > self.kv.num_pages - 1)  # page 0 = trash
+                if isinstance(e, KVPoolExhausted) and not never_fits:
+                    # transient: active sequences hold the pool. Requeue
+                    # at the FRONT (FIFO position kept — a tail requeue
+                    # would let every later small request leapfrog a big
+                    # one forever, its future never resolving) and stop
+                    # admitting; retired sequences free pages and the
+                    # pump re-runs _admit every step. (A request bigger
+                    # than the whole pool still fails: requeueing it
+                    # would spin forever.)
+                    with self._waiting.mutex:
+                        self._waiting.queue.appendleft(req)
+                        self._waiting.not_empty.notify()
+                    break
                 if req.future is not None and not req.future.done():
                     req.future.set_exception(e)
                 if req.stream_q is not None:
@@ -404,8 +429,14 @@ class PagedBatcher:
             while reused and len(reused) * self.page_size >= n:
                 self.kv.stats["prefix_hit_pages"] -= 1
                 reused.pop()
+        # every acquisition lands in req.pages IMMEDIATELY so the
+        # _admit cleanup path can decref exactly what was taken when an
+        # alloc below raises mid-admit (incref'd reused-prefix pages and
+        # partial fresh allocations both leaked before)
+        req.pages = []
         for page in reused:
             self.kv.incref(page)
+            req.pages.append(page)
         prefix_len = len(reused) * self.page_size
         self.stats["prefix_hit_tokens"] += prefix_len
         # LAZY allocation: only the pages the sequence occupies right
@@ -413,9 +444,8 @@ class PagedBatcher:
         # happens per step in _grow_pages; this is what lets the pool be
         # smaller than slots × pages_per_seq (vLLM's overcommit)
         n_pages_now = n // self.page_size + 1
-        fresh = [self.kv.alloc()
-                 for _ in range(n_pages_now - len(reused))]
-        req.pages = list(reused) + fresh
+        for _ in range(n_pages_now - len(reused)):
+            req.pages.append(self.kv.alloc())
         page_ids = self._padded_page_ids(req.pages)
 
         if req.premade_row is not None:

@@ -1,0 +1,74 @@
+"""Head-side aggregation of bus events (runs inside the GCS process).
+
+Reference: GcsTaskManager — the GCS keeps a bounded, queryable history
+of worker-pushed events rather than a full time-series store. Spans are
+additionally indexed by job so ``GetTrace`` is O(job), not O(history).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+_EVENTS_MAX = 50_000
+_SPANS_PER_JOB_MAX = 20_000
+_JOBS_MAX = 64
+
+
+class EventAggregator:
+    def __init__(self) -> None:
+        self.events: deque = deque(maxlen=_EVENTS_MAX)
+        # job_id -> deque of span events (insertion-ordered; also the
+        # job LRU: oldest job evicted past _JOBS_MAX)
+        self.spans_by_job: "Dict[str, deque]" = {}
+        # node_id -> latest reporter sample from that node's agent
+        self.node_stats: Dict[str, dict] = {}
+
+    def add(self, events: List[dict]) -> None:
+        for ev in events:
+            self.events.append(ev)
+            if ev.get("type") == "span":
+                job = ev.get("job_id") or "_nojob"
+                q = self.spans_by_job.pop(job, None)
+                if q is None:
+                    q = deque(maxlen=_SPANS_PER_JOB_MAX)
+                # reinsert on every span so dict order is recency order
+                # (true LRU): past _JOBS_MAX the evicted job is the one
+                # longest idle, never a live job still producing spans
+                self.spans_by_job[job] = q
+                while len(self.spans_by_job) > _JOBS_MAX:
+                    oldest = next(iter(self.spans_by_job))
+                    del self.spans_by_job[oldest]
+                q.append(ev)
+
+    def list_events(self, etype: Optional[str] = None,
+                    job_id: Optional[str] = None,
+                    limit: int = 1000) -> List[dict]:
+        out = [
+            e for e in self.events
+            if (etype is None or e.get("type") == etype)
+            and (job_id is None or e.get("job_id") == job_id)
+        ]
+        return out[-limit:]
+
+    def get_trace(self, job_id: str) -> Dict[str, Any]:
+        """The job's span records plus a parent→children index — enough
+        for an exporter to rebuild the tree without re-deriving it."""
+        spans = list(self.spans_by_job.get(job_id, ()))
+        children: Dict[str, List[str]] = {}
+        roots: List[str] = []
+        for s in spans:
+            pid = s.get("parent_span_id") or ""
+            if pid:
+                children.setdefault(pid, []).append(s["span_id"])
+            else:
+                roots.append(s["span_id"])
+        return {"job_id": job_id, "spans": spans,
+                "roots": roots, "children": children}
+
+    def set_node_stats(self, node_id: str, stats: dict) -> None:
+        self.node_stats[node_id] = dict(stats, reported_at=time.time())
+
+    def list_node_stats(self) -> List[dict]:
+        return [dict(s, node_id=n) for n, s in self.node_stats.items()]

@@ -66,6 +66,14 @@ class Replica:
         self._ongoing = 0
         self._ongoing_peak = 0
         self._ongoing_lock = threading.Lock()
+        # streams get their OWN cap, below the request cap, so
+        # long-lived streams can't occupy every slot and starve unary
+        # traffic. Degenerate cases keep streaming usable rather than
+        # the invariant absolute: max_ongoing=1 still admits 1 stream
+        # (which then does fill the only slot), 0 = unenforced.
+        self._max_streams = max(1, max_ongoing_requests - 1) \
+            if max_ongoing_requests else 0
+        self._streams = 0
 
     def _acquire_slot(self) -> bool:
         with self._ongoing_lock:
@@ -148,12 +156,29 @@ class Replica:
                                  multiplexed_model_id: str = ""):
         """Generator method: the actor-streaming machinery turns each yield
         into an ObjectRefGenerator item on the caller (replica.py:1630).
-        Streams occupy a capacity slot for their whole lifetime (but are
-        not rejected — the first-yield protocol would race the consumer);
-        their load is therefore visible to unary rejection."""
+        Streams occupy a capacity slot for their whole lifetime, visible
+        to unary rejection — but they draw from a SEPARATE stream budget
+        (max_ongoing - 1, floored at 1 so a cap-1 replica can still
+        stream): a burst of long-lived streams saturating every replica
+        slot would starve unary traffic until a stream ends. At the
+        stream cap the call raises BEFORE the first yield (the consumer
+        sees the error as the stream's first item) instead of queueing
+        past the cap."""
         from ray_tpu.serve.multiplex import _current_model_id
 
         with self._ongoing_lock:
+            if self._max_streams and self._streams >= self._max_streams:
+                raise RuntimeError(
+                    f"replica stream capacity exhausted "
+                    f"({self._streams}/{self._max_streams} streams)")
+            if self._max_ongoing and self._ongoing >= self._max_ongoing:
+                # the overall request cap binds streams too — now that
+                # streams reject pre-first-yield, admitting past it would
+                # let stream bursts exceed the configured concurrency
+                raise RuntimeError(
+                    f"replica capacity exhausted "
+                    f"({self._ongoing}/{self._max_ongoing} requests)")
+            self._streams += 1
             self._ongoing += 1
             self._ongoing_peak = max(self._ongoing_peak, self._ongoing)
         token = _current_model_id.set(multiplexed_model_id)
@@ -165,7 +190,9 @@ class Replica:
             yield from out
         finally:
             _current_model_id.reset(token)
-            self._release_slot()
+            with self._ongoing_lock:
+                self._streams -= 1
+                self._ongoing -= 1
 
     def multiplexed_model_ids(self) -> list:
         from ray_tpu.serve.multiplex import replica_multiplexed_model_ids

@@ -285,19 +285,29 @@ class DeploymentHandle:
         return _HandleMethod(self, "__call__").remote(*args, **kwargs)
 
     def _call(self, method: str, args, kwargs, model_id: str = ""):
+        from ray_tpu.observability import tracing as obs_tracing
+
         rs = self._rs
         idx = rs.pick_for_model(model_id) if model_id else rs.pick()
         actor = rs.actors[idx]
-        if method in self._streaming_methods:
-            gen = actor.handle_request_streaming.remote(
+        # request span: the replica-side execution span parents to this
+        # one (the trace context is injected into the actor submit below
+        # while the span is active) — so a trace shows proxy→replica
+        # hops. One enabled-check when tracing is off.
+        with obs_tracing.span(
+                "serve.request", kind="serve",
+                attrs={"deployment": self._name, "method": method,
+                       "replica": idx}):
+            if method in self._streaming_methods:
+                gen = actor.handle_request_streaming.remote(
+                    method, args, kwargs, model_id)
+                # the stream holds the routing slot until it completes or
+                # is dropped — otherwise streaming load is invisible to
+                # pow-2 routing and the autoscaler
+                gen._set_close_callback(lambda: rs.release(idx))
+                return gen
+            ref = actor.handle_request_with_rejection.remote(
                 method, args, kwargs, model_id)
-            # the stream holds the routing slot until it completes or is
-            # dropped — otherwise streaming load is invisible to pow-2
-            # routing and the autoscaler
-            gen._set_close_callback(lambda: rs.release(idx))
-            return gen
-        ref = actor.handle_request_with_rejection.remote(
-            method, args, kwargs, model_id)
         return DeploymentResponse(
             ref, on_done=lambda: rs.release(idx),
             # rejection re-pick goes through the LIVE handle state: a

@@ -11,13 +11,45 @@ reduce locally (zero-copy reads on one node).
 
 from __future__ import annotations
 
+import contextlib
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 import ray_tpu
+from ray_tpu.observability import tracing as obs_tracing
 from ray_tpu.util.collective.types import ReduceOp
+
+def _bandwidth_histogram():
+    """Per-op effective bandwidth (MB/s) on the Prometheus scrape."""
+    from ray_tpu.util.metrics import get_histogram
+
+    return get_histogram(
+        "ray_tpu_collective_mb_per_s",
+        description="Collective op effective bandwidth",
+        boundaries=(1, 10, 50, 100, 500, 1000, 5000, 20000),
+        tag_keys=("op",),
+    )
+
+
+@contextlib.contextmanager
+def _op_span(op: str, nbytes: int, world_size: int, rank: int):
+    """Collective op start/end: a span (parents into whatever trace the
+    calling task inherited) plus the bandwidth histogram sample."""
+    t0 = time.monotonic()
+    with obs_tracing.span(
+            f"collective.{op}", kind="collective",
+            attrs={"op": op, "nbytes": nbytes,
+                   "world_size": world_size, "rank": rank}):
+        yield
+    dur = time.monotonic() - t0
+    if dur > 0 and nbytes:
+        try:
+            _bandwidth_histogram().observe(
+                nbytes / dur / 1e6, tags={"op": op})
+        except Exception:  # noqa: BLE001 — metrics must not fail the op
+            pass
 
 _NUMPY_REDUCERS = {
     ReduceOp.SUM: lambda xs: np.sum(xs, axis=0),
@@ -108,6 +140,9 @@ class ObjStoreGroup:
         # (shape, dtype) -> (my_channel, [(rank, reader), ...]) or None
         # (None = cross-host group: stay on the object path)
         self._channels: Dict[Tuple, Optional[Tuple[Any, List]]] = {}
+        # fixed-shape metadata channels for the per-op routing agreement
+        # (() = not yet set up, None = cross-host: channel plane off)
+        self._meta: Any = ()
         # (enabled, max_bytes) agreed across ALL ranks at first use —
         # per-rank env knobs must not diverge the per-op exchange keys
         # (a rank going object-path while peers go channel-path would
@@ -174,10 +209,10 @@ class ObjStoreGroup:
         self._policy = (enabled, max_bytes)
         return self._policy
 
-    def _ensure_channels(self, shape, dtype) -> Optional[Tuple[Any, List]]:
-        key = (tuple(shape), str(dtype))
-        if key in self._channels:
-            return self._channels[key]
+    def _make_channel_set(self, shape, dtype, rdv_key: str):
+        """One object-path exchange advertises every rank's channel;
+        returns (my_channel, [(rank, reader), ...]) or None when the
+        group spans hosts or the advertised (shape, dtype) disagree."""
         import socket
 
         from ray_tpu.experimental.channel import (
@@ -185,17 +220,16 @@ class ObjStoreGroup:
             TensorChannelReader,
         )
 
+        key = (tuple(shape), str(dtype))
         host = socket.gethostname()
         mine = TensorChannel(shape, str(dtype),
                              num_readers=self.world_size - 1)
-        # one object-path exchange advertises every rank's channel
-        infos = self._exchange(f"chsetup_{key}", (host, mine.name))
-        if any(h != host for h, _ in infos):
+        infos = self._exchange(rdv_key, (host, key, mine.name))
+        if any(h != host or k != key for h, k, _ in infos):
             mine.close()
-            self._channels[key] = None  # cross-host: object path
             return None
         readers: List[Tuple[int, Any]] = []
-        for r, (_h, nm) in enumerate(infos):
+        for r, (_h, _k, nm) in enumerate(infos):
             if r == self.rank:
                 continue
             # reader slot within rank r's channel: peers in rank order,
@@ -203,14 +237,71 @@ class ObjStoreGroup:
             ridx = self.rank if self.rank < r else self.rank - 1
             readers.append((r, TensorChannelReader(
                 nm, shape, str(dtype), self.world_size - 1, ridx)))
-        self._channels[key] = (mine, readers)
-        return self._channels[key]
+        return (mine, readers)
+
+    def _ensure_meta_channels(self):
+        """Fixed-shape (int64[2]) channels for the PER-OP routing
+        agreement. Set up through one shape-INDEPENDENT rendezvous
+        ("metasetup") the first time any rank tries the channel plane —
+        every rank reaches it regardless of tensor shapes, so setup
+        itself can't split across keys. None = cross-host group."""
+        if self._meta == ():
+            self._meta = self._make_channel_set((2,), "int64", "metasetup")
+        return self._meta
+
+    def _ensure_channels(self, shape, dtype) -> Optional[Tuple[Any, List]]:
+        key = (tuple(shape), str(dtype))
+        st = self._channels.get(key, ())
+        if st != ():
+            return st
+        st = self._make_channel_set(shape, dtype, "chsetup")
+        if st is None and self._meta is not None:
+            # shape-signature collision let mismatched ranks through the
+            # meta agreement (same host, or this would be the cross-host
+            # branch): don't cache — caching None per-rank under
+            # DIFFERENT keys would desync the next chsetup rendezvous
+            return None
+        self._channels[key] = st
+        return st
+
+    def _shape_sig(self, arr: np.ndarray) -> int:
+        import zlib
+
+        return zlib.crc32(repr((arr.shape, str(arr.dtype))).encode())
 
     def _channel_exchange(self, arr: np.ndarray) -> Optional[List[np.ndarray]]:
-        """Write mine once, read every peer's; None = not channelable."""
+        """Write mine once, read every peer's; None = not channelable.
+
+        Routing (channel plane vs object path) must be decided
+        IDENTICALLY on every rank, but it depends on per-rank state —
+        the tensor's shape/size and each rank's channel cache. So every
+        op first exchanges (shape-sig, nbytes) over a fixed-shape meta
+        channel (a couple of seqlock shm reads, no actor round-trips)
+        and each rank applies the same rule to the same vector: all
+        metas equal and under the size cap → data channels, anything
+        else → everyone takes the object path. Without the per-op
+        agreement, a rank whose (shape, dtype) is already cached would
+        skip the one-time rendezvous that peers with a DIFFERENT shape
+        are blocked in — mismatched-shape ops after a matching warm-up,
+        or ops straddling the size threshold, would deadlock both sides
+        for the full 120s and desync the exchange seq (advisor
+        finding)."""
         enabled, max_bytes = self._ensure_policy()
-        if not enabled or arr.nbytes > max_bytes:
-            return None  # bandwidth-bound (or disabled): object path
+        if not enabled:
+            return None  # group-agreed constant: identical on all ranks
+        meta = self._ensure_meta_channels()
+        if meta is None:
+            return None  # cross-host: object path (symmetric on all ranks)
+        meta_ch, meta_readers = meta
+        sig = np.array([self._shape_sig(arr), arr.nbytes], np.int64)
+        meta_ch.write(sig, timeout=120.0)
+        agree = True
+        for _r, rd in meta_readers:
+            peer = rd.read(timeout=120.0)
+            if peer[0] != sig[0] or peer[1] != sig[1]:
+                agree = False  # keep reading: drain every peer's slot
+        if not agree or arr.nbytes > max_bytes:
+            return None  # same decision everywhere, by construction
         st = self._ensure_channels(arr.shape, arr.dtype)
         if st is None:
             return None
@@ -227,17 +318,19 @@ class ObjStoreGroup:
 
     def allreduce(self, tensor: Any, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
         arr = np.ascontiguousarray(tensor)
-        parts = self._channel_exchange(arr)
-        if parts is None:
-            parts = self._exchange("allreduce", arr)
-        return _NUMPY_REDUCERS[ReduceOp(op)](np.stack(parts))
+        with _op_span("allreduce", arr.nbytes, self.world_size, self.rank):
+            parts = self._channel_exchange(arr)
+            if parts is None:
+                parts = self._exchange("allreduce", arr)
+            return _NUMPY_REDUCERS[ReduceOp(op)](np.stack(parts))
 
     def allgather(self, tensor: Any) -> List[np.ndarray]:
         arr = np.ascontiguousarray(tensor)
-        parts = self._channel_exchange(arr)
-        if parts is None:
-            parts = self._exchange("allgather", arr)
-        return parts
+        with _op_span("allgather", arr.nbytes, self.world_size, self.rank):
+            parts = self._channel_exchange(arr)
+            if parts is None:
+                parts = self._exchange("allgather", arr)
+            return parts
 
     def reducescatter(self, tensor: Any, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
         red = self.allreduce(tensor, op)
@@ -245,11 +338,14 @@ class ObjStoreGroup:
         return chunks[self.rank]
 
     def broadcast(self, tensor: Any, src_rank: int = 0) -> np.ndarray:
-        parts = self._exchange("broadcast", np.asarray(tensor))
-        return parts[src_rank]
+        arr = np.asarray(tensor)
+        with _op_span("broadcast", arr.nbytes, self.world_size, self.rank):
+            parts = self._exchange("broadcast", arr)
+            return parts[src_rank]
 
     def barrier(self) -> None:
-        self._exchange("barrier", np.zeros(()))
+        with _op_span("barrier", 0, self.world_size, self.rank):
+            self._exchange("barrier", np.zeros(()))
 
     # -- p2p: per-pair sequence counters, single-rank collect -----------
     def send(self, tensor: Any, dst_rank: int) -> None:

@@ -192,3 +192,26 @@ class Histogram(Metric):
         return {"name": self._name, "type": "histogram",
                 "description": self._description,
                 "bounds": list(self._bounds), "series": series}
+
+
+_named_hist_lock = threading.Lock()
+_named_hists: Dict[str, Histogram] = {}
+
+
+def get_histogram(name: str, description: str = "",
+                  boundaries: Sequence[float] = _DEFAULT_HIST_BUCKETS,
+                  tag_keys: Sequence[str] = ()) -> Histogram:
+    """Process-wide idempotent histogram lookup: instrumentation call
+    sites (task latency, queue wait, collective bandwidth) share one
+    instance per name without each carrying its own lazy-init globals.
+    First caller's description/boundaries win; registration (and the
+    pusher thread) happens only when a site actually records."""
+    h = _named_hists.get(name)
+    if h is None:
+        with _named_hist_lock:
+            h = _named_hists.get(name)
+            if h is None:
+                h = _named_hists[name] = Histogram(
+                    name, description=description,
+                    boundaries=boundaries, tag_keys=tag_keys)
+    return h

@@ -60,6 +60,29 @@ def task_summary() -> Dict[str, int]:
     return counts
 
 
+def list_events(etype: Optional[str] = None, job_id: Optional[str] = None,
+                limit: int = 1000) -> List[Dict[str, Any]]:
+    """Cluster event-bus history (observability/events.py): typed events
+    (task state transitions, object put/get, actor restarts, collective
+    ops, spans) aggregated at the GCS. Also at GET /api/v0/events."""
+    return _gcs().call_retrying("ListClusterEvents", etype=etype,
+                                job_id=job_id, limit=limit)
+
+
+def get_trace(job_id: str) -> Dict[str, Any]:
+    """A job's span tree from the distributed-tracing subsystem:
+    ``{"job_id", "spans": [...], "roots": [...], "children": {...}}``.
+    Same payload as GET /api/v0/traces/<job_id> on the dashboard head;
+    export with ``ray_tpu.observability.export_trace``."""
+    return _gcs().call_retrying("GetTrace", job_id=job_id)
+
+
+def list_node_stats() -> List[Dict[str, Any]]:
+    """Latest per-node reporter samples (dashboard agents' reporter
+    loops): cpu/mem, worker and lease counts, object-store fill."""
+    return _gcs().call_retrying("ListNodeStats")
+
+
 def metrics_endpoint() -> str:
     """Prometheus scrape address, e.g. "127.0.0.1:9201" (reference: the
     dashboard agent's metrics exporter)."""

@@ -27,6 +27,31 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import pytest  # noqa: E402
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--stress-repeat", type=int, default=1, metavar="N",
+        help="run every @pytest.mark.stress test N times (race "
+             "discipline: the seqlock channels, the paged batcher pump, "
+             "collective rendezvous, and event-bus flush suites are "
+             "timing-sensitive; one green run proves little)")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "stress: race-prone suite, repeated --stress-repeat "
+                   "times by the repeat-runner")
+    config.addinivalue_line("markers", "slow: excluded from tier-1 runs")
+
+
+def pytest_generate_tests(metafunc):
+    """Repeat-runner: parametrize stress-marked tests N times so
+    ``pytest -m stress --stress-repeat=20`` hammers the racy paths."""
+    n = metafunc.config.getoption("--stress-repeat")
+    if n > 1 and metafunc.definition.get_closest_marker("stress"):
+        metafunc.fixturenames.append("_stress_rep")
+        metafunc.parametrize("_stress_rep", range(n))
+
+
 @pytest.fixture
 def ray_start_local():
     import ray_tpu

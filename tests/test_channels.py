@@ -21,6 +21,7 @@ class TestLocal:
         assert r.read() == {"a": 1, "b": [2, 3]}
         ch.close()
 
+    @pytest.mark.stress
     def test_in_place_rewrite_many_values(self):
         ch = Channel(capacity=1 << 16)
         r = ch.reader()
@@ -38,6 +39,7 @@ class TestLocal:
         assert got == list(range(100))
         ch.close()
 
+    @pytest.mark.stress
     def test_backpressure_blocks_writer(self):
         ch = Channel(capacity=1 << 16)
         ch.reader()  # never reads
@@ -59,6 +61,7 @@ class TestLocal:
             ch.write(np.zeros(1024))
         ch.close()
 
+    @pytest.mark.stress
     def test_two_readers_each_get_every_value(self):
         ch = Channel(capacity=1 << 16, num_readers=2)
         r0, r1 = ch.reader(0), ch.reader(1)

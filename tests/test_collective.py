@@ -56,6 +56,7 @@ class TestXLABackend:
 
 
 class TestObjStoreBackend:
+    @pytest.mark.stress
     def test_allreduce_across_actors(self, ray_start_regular):
         @ray_tpu.remote
         class Worker:

@@ -142,6 +142,7 @@ class TestPagedBatcher:
         finally:
             pb.shutdown()
 
+    @pytest.mark.stress
     def test_overcommit_preempts_and_recovers(self, tiny_model):
         """Pool smaller than slots×pages_per_seq: lazy growth runs out,
         the youngest slot is preempted (recompute) and every request
