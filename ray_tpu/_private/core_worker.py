@@ -957,11 +957,17 @@ class CoreWorker(CoreRuntime):
     def put(self, value: Any) -> ObjectRef:
         w = worker_mod.global_worker
         oid = ObjectID.from_index(w.current_task_id, w.next_put_index())
-        from ray_tpu._private.serialization import collect_object_refs
+        from ray_tpu._private.serialization import (
+            collect_object_refs,
+            serialize_prepare,
+        )
 
         with collect_object_refs() as col:
-            data = serialize(value)
-        self.put_serialized(oid, data)
+            sv = serialize_prepare(value)
+        try:
+            self.put_prepared(oid, sv)
+        finally:
+            sv.release()
         rc = self._ref_counter()
         rc.add_owned_object(oid)
         if col.refs:
@@ -973,6 +979,26 @@ class CoreWorker(CoreRuntime):
             with self._borrow_lock:
                 self._put_contained[oid] = inner
         return ObjectRef(oid, owner_addr=self.address)
+
+    def put_prepared(self, oid: ObjectID, sv) -> None:
+        """Store a prepared (two-phase) serialized value as an owned
+        object: inline in the memory store below the threshold, else
+        written in place into the reserved shm mapping
+        (Create → write-in-place → Seal — 0 intermediate payload
+        copies). The caller releases ``sv``."""
+        if obs_tracing.active():
+            obs_events.record_event(
+                "object_put", size=sv.total, job_id=self.job_id.hex(),
+                inline=sv.total <= config.object_store_inline_max_bytes)
+        if sv.total <= config.object_store_inline_max_bytes:
+            # small objects stay inline in the owner memory store; the
+            # join is expected here and counted on the "inline" series,
+            # keeping the zero-copy "put" invariant series clean
+            self.memory_store.put(
+                oid, ("inline", sv.to_bytes(copy_path="inline")))
+        else:
+            self._plasma_put_segments(oid, sv)
+            self.memory_store.put(oid, ("plasma", self.node_id))
 
     def put_serialized(self, oid: ObjectID, data: bytes) -> None:
         if obs_tracing.active():
@@ -1019,10 +1045,29 @@ class CoreWorker(CoreRuntime):
         pressure; no-op if the object already exists."""
         try:
             buf = self._plasma_create_backpressure(oid, len(data))
+        except FileExistsError:
+            return
+        try:
             buf.data[:] = data
             buf.seal()
+        except BaseException:
+            buf.abort()
+            raise
+
+    def _plasma_put_segments(self, oid: ObjectID, sv) -> None:
+        """Zero-copy plasma put: reserve ``sv.total`` bytes, write the
+        serialized frame in place (payload moves source → shm exactly
+        once), seal. No-op if the object already exists."""
+        try:
+            buf = self._plasma_create_backpressure(oid, sv.total)
         except FileExistsError:
-            pass
+            return
+        try:
+            sv.write_into(buf.data)
+            buf.seal()
+        except BaseException:
+            buf.abort()
+            raise
 
     def _node_raylet_addr(self, node_id: str) -> Optional[Tuple[str, int]]:
         with self._node_addrs_lock:
@@ -1406,22 +1451,32 @@ class CoreWorker(CoreRuntime):
                 self._ref_counter().add_submitted_task_ref(v.id())
                 owner = v.owner_address or self.address
                 return TaskArg(is_ref=True, object_id=v.id(), owner_addr=tuple(owner))
-            from ray_tpu._private.serialization import collect_object_refs
+            from ray_tpu._private.serialization import (
+                collect_object_refs,
+                serialize_prepare,
+            )
 
             with collect_object_refs() as col:
-                data = serialize(v)
-            for r in col.refs:
-                self._ref_counter().add_submitted_task_ref(r.id())
-                contained.append(r.id())
-            if len(data) > config.object_store_inline_max_bytes:
-                # promote big arg to an owned shared-memory object
-                w = worker_mod.global_worker
-                oid = ObjectID.from_index(w.current_task_id, w.next_put_index())
-                self.put_serialized(oid, data)
-                self._ref_counter().add_owned_object(oid)
-                self._ref_counter().add_submitted_task_ref(oid)
-                return TaskArg(is_ref=True, object_id=oid, owner_addr=self.address)
-            return TaskArg(is_ref=False, value=data)
+                sv = serialize_prepare(v)
+            try:
+                for r in col.refs:
+                    self._ref_counter().add_submitted_task_ref(r.id())
+                    contained.append(r.id())
+                if sv.total > config.object_store_inline_max_bytes:
+                    # promote big arg to an owned shared-memory object,
+                    # written in place (zero-copy)
+                    w = worker_mod.global_worker
+                    oid = ObjectID.from_index(
+                        w.current_task_id, w.next_put_index())
+                    self.put_prepared(oid, sv)
+                    self._ref_counter().add_owned_object(oid)
+                    self._ref_counter().add_submitted_task_ref(oid)
+                    return TaskArg(
+                        is_ref=True, object_id=oid, owner_addr=self.address)
+                return TaskArg(
+                    is_ref=False, value=sv.to_bytes(copy_path="inline"))
+            finally:
+                sv.release()
 
         for a in args:
             out_args.append(conv(a))
@@ -2703,4 +2758,18 @@ class CoreWorker(CoreRuntime):
         try:
             self.plasma.close()
         except Exception:
+            pass
+        # close every RPC client this process opened: each one owns a
+        # read-loop task that must be cancelled AND awaited, or asyncio
+        # logs "Task was destroyed but it is pending!" at exit
+        from ray_tpu._private.rpc import clear_client_cache
+
+        for c in (self.gcs, self.raylet):
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001
+                pass
+        try:
+            clear_client_cache()
+        except Exception:  # noqa: BLE001
             pass

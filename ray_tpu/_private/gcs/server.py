@@ -164,6 +164,9 @@ class GcsServer:
             self._dirty = True
             self._compact()
         self.server.register_instance(self)
+        # pubsub long-poll parks for its whole timeout by design — exempt
+        # it from the transport's slow-async-handler warning
+        self.server.register("Subscribe", self.Subscribe, long_poll=True)
         self.server.pre_response = self._wal_barrier
 
     # ------------------------------------------------------------------

@@ -86,18 +86,23 @@ def spawn_gcs(port: int, session_dir: str, log_name: str = "gcs.log") -> subproc
     # generous: a loaded CI box (a full suite's worth of processes on
     # one core) can take >30s just to schedule the interpreter start
     deadline = time.monotonic() + 60
-    while True:
-        try:
-            client.call("Ping", timeout=2)
-            return proc
-        except Exception:
-            if proc.poll() is not None:
-                raise RuntimeError(
-                    f"GCS exited with {proc.returncode}; see {session_dir}/{log_name}"
-                )
-            if time.monotonic() > deadline:
-                raise RuntimeError("GCS did not become ready")
-            time.sleep(0.05)
+    try:
+        while True:
+            try:
+                client.call("Ping", timeout=2)
+                return proc
+            except Exception:
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"GCS exited with {proc.returncode}; see {session_dir}/{log_name}"
+                    )
+                if time.monotonic() > deadline:
+                    raise RuntimeError("GCS did not become ready")
+                time.sleep(0.05)
+    finally:
+        # probe client: close (cancel + await its read loop) rather than
+        # abandoning the task to be GC'd mid-read ("Task was destroyed")
+        client.close()
 
 
 def spawn_raylet(
@@ -230,14 +235,17 @@ class Node:
     def _wait_rpc_ready(self, addr: Tuple[str, int], name: str, timeout: float = 30.0) -> None:
         client = RpcClient(addr[0], addr[1])
         deadline = time.monotonic() + timeout
-        while True:
-            try:
-                client.call("Ping", timeout=2)
-                return
-            except Exception:
-                if time.monotonic() > deadline:
-                    raise RuntimeError(f"{name} did not become ready at {addr}")
-                time.sleep(0.05)
+        try:
+            while True:
+                try:
+                    client.call("Ping", timeout=2)
+                    return
+                except Exception:
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(f"{name} did not become ready at {addr}")
+                    time.sleep(0.05)
+        finally:
+            client.close()
 
     def stop(self) -> None:
         # kill whole trees (the raylet owns the store daemon + workers)

@@ -139,8 +139,14 @@ def main(small: bool = False) -> List[Dict]:
     trd = tch.reader()
 
     def tchan_rt():
+        # the zero-copy data plane's consumption pattern (what the
+        # pipelined collectives do): borrow the slot view, consume it in
+        # place, release — payload bytes move exactly once, writer → shm
         tch.write(arr_1mb)
-        trd.read()
+        v = trd.read_view()
+        consumed = v[0, 0]  # touch: the view IS the data
+        trd.release()
+        return consumed
 
     results.append(timeit("tensor channel write+read (1MB)", tchan_rt,
                           duration_s=dur))

@@ -11,6 +11,19 @@ Frame layout:
     request:  [u64 call_id][u8 kind][pickle (method, kwargs)]
     response: [u64 call_id][u8 kind][pickle (ok, payload)]
 kind: 0 = request, 1 = response, 2 = oneway (no response expected).
+The high bit of ``kind`` (0x80) flags an out-of-band framed body:
+    [u32 meta_len][meta pickle][u32 nbuffers][u64 len, raw bytes]...
+where the payload buffers were captured by the pickle-5 buffer callback
+and travel as zero-copy views — large numpy/bytes payloads are never
+joined into one bytes object on the send side. Receive-side contract:
+out-of-band payloads (buffers >= 4 KiB) reconstruct as READ-ONLY arrays
+viewing the frame buffer (np.copy() to mutate); sub-4KiB buffers stay
+in-band and arrive writable as before.
+
+Framing fast path: header + body + out-of-band buffers reach the socket
+through gather writes (no concatenation of large segments), and small
+frames queued within one event-loop tick coalesce into a single
+transport write.
 """
 
 from __future__ import annotations
@@ -20,6 +33,7 @@ import logging
 import pickle
 import random
 import struct
+import sys
 import threading
 import time
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
@@ -31,6 +45,25 @@ logger = logging.getLogger(__name__)
 KIND_REQUEST = 0
 KIND_RESPONSE = 1
 KIND_ONEWAY = 2
+# flag bit on ``kind``: body uses the meta + out-of-band buffer framing
+KIND_OOB_FLAG = 0x80
+KIND_MASK = 0x7F
+
+_HDR = struct.Struct("<IQB")
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+
+# Frames at or below this size coalesce: queued per-writer and flushed in
+# one transport write at the end of the current event-loop tick, so a
+# burst of small frames (actor-task batches, acks) costs one syscall.
+_SMALL_FRAME_MAX = 8192
+# Segments at least this large are handed to the transport as views (no
+# concatenation); smaller neighbours are joined to bound syscall count.
+_GATHER_CUTOFF = 32 * 1024
+# Bodies above this size are pickled/unpickled on the executor, not the
+# event loop, so one fat CreateActor/PushTask payload cannot stall every
+# connection sharing the loop.
+_LOOP_DECODE_MAX = 256 * 1024
 
 
 class RpcError(Exception):
@@ -87,8 +120,148 @@ async def _read_frame(reader: asyncio.StreamReader) -> Tuple[int, int, bytes]:
     return call_id, kind, body
 
 
-def _write_frame(writer: asyncio.StreamWriter, call_id: int, kind: int, body: bytes) -> None:
-    writer.write(struct.pack("<IQB", len(body), call_id, kind) + body)
+# buffers below this stay IN-band: they arrive writable (old semantics)
+# and a tiny out-of-band segment saves nothing
+_OOB_MIN_BYTES = 4096
+
+
+def _encode_body(obj: Any) -> Tuple[int, list, int]:
+    """Pickle an RPC body. LARGE buffer-protocol payloads (numpy arrays,
+    ...) are captured by the pickle-5 buffer callback and stay OUT OF
+    BAND as zero-copy view segments; sub-4KiB buffers serialize in-band
+    (writable on receipt, as before). Returns (kind_flags, segments,
+    total_len).
+
+    NOTE the wire layout below deliberately mirrors
+    serialization.SerializedValue.segments() / deserialize() — if one
+    grows a header field or alignment padding, change both."""
+    bufs: list = []
+
+    def _cb(b: pickle.PickleBuffer):
+        if b.raw().nbytes < _OOB_MIN_BYTES:
+            return True  # truthy = pickle keeps the buffer in-band
+        bufs.append(b)
+        return False
+
+    meta = pickle.dumps(obj, protocol=5, buffer_callback=_cb)
+    if not bufs:
+        return 0, [meta], len(meta)
+    segs: list = [_U32.pack(len(meta)), meta, _U32.pack(len(bufs))]
+    total = 8 + len(meta)
+    for b in bufs:
+        raw = b.raw()
+        if raw.ndim != 1 or raw.format != "B":
+            raw = raw.cast("B")
+        segs.append(_U64.pack(raw.nbytes))
+        segs.append(raw)
+        total += 8 + raw.nbytes
+    return KIND_OOB_FLAG, segs, total
+
+
+def _decode_body(kind: int, body: bytes) -> Any:
+    """Inverse of _encode_body; out-of-band buffers are zero-copy views
+    into the received body."""
+    if not kind & KIND_OOB_FLAG:
+        return pickle.loads(body)
+    mv = memoryview(body)
+    (meta_len,) = _U32.unpack_from(mv, 0)
+    off = 4
+    meta = mv[off: off + meta_len]
+    off += meta_len
+    (nbuf,) = _U32.unpack_from(mv, off)
+    off += 4
+    buffers = []
+    for _ in range(nbuf):
+        (blen,) = _U64.unpack_from(mv, off)
+        off += 8
+        buffers.append(mv[off: off + blen])
+        off += blen
+    return pickle.loads(meta, buffers=buffers)
+
+
+class _FrameSink:
+    """Per-connection gather-write sink.
+
+    The FIRST frame of an event-loop tick writes through immediately (a
+    lone latency-sensitive call pays zero batching delay); small frames
+    that follow in the SAME tick coalesce and go out as one transport
+    write at tick end — a burst of N small frames (actor-task batches,
+    acks) costs 2 syscalls instead of N. Large frames always write
+    through, vectored: the header and sub-cutoff segments join into one
+    small write, every large segment is handed to the transport as a
+    view, uncopied.
+
+    Borrow safety: on CPython <3.12 ``transport.write`` consumes data
+    synchronously (sent, or copied into the transport's bytearray), so
+    borrowed views are safe to mutate once write_frame returns. 3.12+
+    selector transports may retain the view object in their write deque
+    under backpressure, so there large segments are materialized before
+    handoff — costs the one copy the old concatenating path always paid,
+    only under backpressure-capable interpreters."""
+
+    _WRITE_CONSUMES_VIEWS = sys.version_info < (3, 12)
+
+    __slots__ = ("writer", "_small", "_tick_armed")
+
+    def __init__(self, writer: asyncio.StreamWriter):
+        self.writer = writer
+        self._small: list = []
+        self._tick_armed = False
+
+    def write_frame(self, call_id: int, kind: int, segs: list, total: int) -> None:
+        header = _HDR.pack(total, call_id, kind)
+        first = not self._tick_armed
+        if first:
+            self._tick_armed = True
+            asyncio.get_event_loop().call_soon(self._end_tick)
+        if total <= _SMALL_FRAME_MAX and not first:
+            # follower in this tick: coalesce. Segments must be owned
+            # bytes, not borrowed views (caller may mutate after return).
+            self._small.append(header)
+            for s in segs:
+                self._small.append(s if isinstance(s, bytes) else bytes(s))
+            return
+        self._flush_small()  # ordering: queued frames go out first
+        acc: list = [header]
+        for s in segs:
+            n = len(s) if isinstance(s, bytes) else s.nbytes
+            if n >= _GATHER_CUTOFF:
+                if acc:
+                    self.writer.write(b"".join(acc))
+                    acc = []
+                if not isinstance(s, bytes) and not self._WRITE_CONSUMES_VIEWS:
+                    s = bytes(s)  # 3.12+: transport may retain the view
+                self.writer.write(s)
+            else:
+                acc.append(s if isinstance(s, bytes) else bytes(s))
+        if acc:
+            self.writer.write(b"".join(acc))
+
+    def _end_tick(self) -> None:
+        self._tick_armed = False
+        self._flush_small()
+
+    def _flush_small(self) -> None:
+        if not self._small:
+            return
+        data = b"".join(self._small)
+        self._small.clear()
+        try:
+            self.writer.write(data)
+        except Exception:  # noqa: BLE001 — connection already torn down
+            pass
+
+
+def _sink(writer: asyncio.StreamWriter) -> _FrameSink:
+    s = getattr(writer, "_rt_sink", None)
+    if s is None:
+        s = writer._rt_sink = _FrameSink(writer)
+    return s
+
+
+def _send_frame(writer: asyncio.StreamWriter, call_id: int, kind: int, obj: Any) -> None:
+    flags, segs, total = _encode_body(obj)
+    _sink(writer).write_frame(call_id, kind | flags, segs, total)
 
 
 class EventLoopThread:
@@ -155,9 +328,15 @@ class RpcServer:
         # this tick — durability-before-ack without a disk sync per
         # mutation.
         self.pre_response: Optional[Callable[[], Awaitable[None]]] = None
+        # methods that legitimately park for their whole timeout (pubsub
+        # long-polls): exempt from the slow-async-handler warning
+        self._long_poll: set = set()
 
-    def register(self, method: str, handler: Callable) -> None:
+    def register(self, method: str, handler: Callable,
+                 long_poll: bool = False) -> None:
         self._handlers[method] = handler
+        if long_poll:
+            self._long_poll.add(method)
 
     def register_instance(self, obj: Any, prefix: str = "") -> None:
         """Register every public method of ``obj`` as a handler."""
@@ -213,9 +392,24 @@ class RpcServer:
 
     async def _dispatch(self, call_id: int, kind: int, body: bytes, writer: asyncio.StreamWriter) -> None:
         t0 = time.monotonic()
+        # Track time the loop is actually HELD by this dispatch (decode +
+        # on-loop handler segments). Executor time is wall-clock for the
+        # caller but does not stall sibling connections — the old warning
+        # charged the whole handler to the loop and cried wolf on every
+        # fat CreateActor that was already safely off-loop.
+        loop_held = 0.0
+        base_kind = kind & KIND_MASK
         method = "?"
+        is_async = False
+        loop = asyncio.get_event_loop()
         try:
-            method, kwargs = pickle.loads(body)
+            if len(body) > _LOOP_DECODE_MAX:
+                # decode runs on the executor: wall time, not loop time
+                method, kwargs = await loop.run_in_executor(
+                    None, _decode_body, kind, body)
+            else:
+                method, kwargs = _decode_body(kind, body)
+                loop_held += time.monotonic() - t0
             chaos = _chaos_action(method)
             if chaos == "request":
                 logger.warning("chaos: dropping rpc %s", method)
@@ -227,36 +421,56 @@ class RpcServer:
             handler = self._handlers.get(method)
             if handler is None:
                 raise RpcError(f"{self.name}: no handler for {method!r}")
-            if asyncio.iscoroutinefunction(handler):
+            is_async = asyncio.iscoroutinefunction(handler)
+            if is_async:
                 result = await handler(**kwargs)
             else:
-                result = await asyncio.get_event_loop().run_in_executor(
+                # sync handlers never run on the loop: the blocking part
+                # of actor bootstrap (ctor-arg unpickling, zygote
+                # handshake) executes on the thread pool
+                result = await loop.run_in_executor(
                     None, lambda: handler(**kwargs)
                 )
             if chaos == "response":
                 # handler side effects happened; the reply is lost
                 logger.warning("chaos: dropping reply of rpc %s", method)
                 return
-            if kind == KIND_ONEWAY:
+            if base_kind == KIND_ONEWAY:
                 return
-            payload = pickle.dumps((True, result), protocol=5)
+            te = time.monotonic()
+            flags, segs, total = _encode_body((True, result))
+            loop_held += time.monotonic() - te
         except Exception as e:  # noqa: BLE001
-            if kind == KIND_ONEWAY:
+            if base_kind == KIND_ONEWAY:
                 logger.exception("%s: oneway handler %s failed", self.name, method)
                 return
             import traceback
 
-            payload = pickle.dumps((False, f"{type(e).__name__}: {e}\n{traceback.format_exc()}"), protocol=5)
+            flags, segs, total = _encode_body(
+                (False, f"{type(e).__name__}: {e}\n{traceback.format_exc()}"))
         dt = time.monotonic() - t0
-        if dt * 1000 > config.event_loop_slow_handler_ms:
-            logger.warning("%s: slow handler %s took %.1fms", self.name, method, dt * 1000)
+        if loop_held * 1000 > config.event_loop_slow_handler_ms:
+            # decode/encode/framing time — genuinely holds the loop for
+            # sync AND async handlers alike
+            logger.warning(
+                "%s: slow handler %s held the event loop %.1fms "
+                "(%.1fms wall)", self.name, method, loop_held * 1000,
+                dt * 1000)
+        elif is_async and dt * 1000 > config.event_loop_slow_handler_ms \
+                and method not in self._long_poll:
+            # an async handler's awaits yield the loop, but CPU-bound
+            # segments inside it do not — keep the wall-clock warning
+            # for async handlers (registered long-polls excepted); sync
+            # handlers run on the executor and no longer cry wolf here
+            logger.warning("%s: slow handler %s took %.1fms",
+                           self.name, method, dt * 1000)
         if self.pre_response is not None:
             try:
                 await self.pre_response()
             except Exception:  # noqa: BLE001
                 logger.exception("%s: pre_response hook failed", self.name)
         try:
-            _write_frame(writer, call_id, KIND_RESPONSE, payload)
+            _sink(writer).write_frame(call_id, KIND_RESPONSE | flags, segs, total)
             await writer.drain()
         except (ConnectionError, RuntimeError):
             pass
@@ -306,11 +520,13 @@ class RpcClient:
                 call_id, kind, body = await _read_frame(reader)
                 fut = self._pending.pop(call_id, None)
                 if fut is not None and not fut.done():
-                    fut.set_result(body)
+                    fut.set_result((kind, body))
         except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
             pass
         finally:
             self._writer = None
+            # teardown must not orphan in-flight response futures: every
+            # caller sees ConnectionError, never a silent hang
             for fut in self._pending.values():
                 if not fut.done():
                     fut.set_exception(RpcConnectionError(f"connection to {self.host}:{self.port} lost"))
@@ -325,17 +541,20 @@ class RpcClient:
         with self._lock:
             self._next_id += 1
             call_id = self._next_id
-        body = pickle.dumps((method, kwargs), protocol=5)
         if oneway:
-            _write_frame(self._writer, call_id, KIND_ONEWAY, body)
+            _send_frame(self._writer, call_id, KIND_ONEWAY, (method, kwargs))
             await self._writer.drain()
             return None
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._pending[call_id] = fut
-        _write_frame(self._writer, call_id, KIND_REQUEST, body)
+        _send_frame(self._writer, call_id, KIND_REQUEST, (method, kwargs))
         await self._writer.drain()
-        body = await asyncio.wait_for(fut, timeout=timeout)
-        ok, payload = pickle.loads(body)
+        kind, body = await asyncio.wait_for(fut, timeout=timeout)
+        if len(body) > _LOOP_DECODE_MAX:
+            ok, payload = await asyncio.get_event_loop().run_in_executor(
+                None, _decode_body, kind, body)
+        else:
+            ok, payload = _decode_body(kind, body)
         if not ok:
             raise RemoteError(payload)
         return payload
@@ -397,14 +616,31 @@ class RpcClient:
         return await asyncio.wrap_future(cf)
 
     def close(self) -> None:
-        w = self._writer
-
         async def _close():
+            # cancel AND await the read loop: a merely-closed writer
+            # leaves the reader task alive until the loop is torn down,
+            # and asyncio then logs "Task was destroyed but it is
+            # pending!" at interpreter exit (BENCH r05 finding)
+            task, self._reader_task = self._reader_task, None
+            w, self._writer = self._writer, None
             if w is not None:
                 try:
                     w.close()
-                except Exception:
+                except Exception:  # noqa: BLE001
                     pass
+            if task is not None and not task.done():
+                task.cancel()
+                try:
+                    await task
+                except BaseException:  # noqa: BLE001 — CancelledError et al.
+                    pass
+            # the read loop's finally failed in-flight futures; cover the
+            # window where close() ran before the loop ever started
+            for fut in list(self._pending.values()):
+                if not fut.done():
+                    fut.set_exception(RpcConnectionError(
+                        f"client to {self.host}:{self.port} closed"))
+            self._pending.clear()
 
         try:
             self._loop_thread.run_coro(_close(), timeout=5)

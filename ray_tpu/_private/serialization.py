@@ -8,6 +8,17 @@ object store), plus a per-job custom-serializer registry.
 Wire format of a serialized object:
     [u32 meta_len][meta pickle][u32 nbuffers][u64 len, bytes]...
 where meta is the cloudpickle payload with PickleBuffer placeholders.
+
+Zero-copy data plane: ``serialize_prepare`` pickles the value ONCE into a
+small meta blob plus borrowed views of the out-of-band payload buffers,
+and ``SerializedValue.write_into`` lays the wire format straight into a
+caller-provided mapping (the plasma Create→write-in-place→Seal path) —
+payload bytes move exactly once, source array → shared memory.  Every
+INTERMEDIATE payload materialization (the legacy bytes-joining
+``serialize``, the pre-3.12 copy-out in ``deserialize``) is recorded in a
+process-local copy counter exported on the metrics scrape
+(``ray_tpu_payload_copies``), so "0 payload copies on the put path" is a
+testable invariant, not a code-review claim.
 """
 
 from __future__ import annotations
@@ -23,6 +34,74 @@ import cloudpickle
 
 _custom_serializers: Dict[type, Tuple[Callable, Callable]] = {}
 _lock = threading.Lock()
+
+# ---------------------------------------------------------------------------
+# Payload-copy accounting. Counts INTERMEDIATE materializations of
+# out-of-band payload bytes (joins into temporary bytes objects, copy-outs
+# of shared-memory views) — NOT the single unavoidable write into the
+# destination mapping/socket. The zero-copy put path must keep the "put"
+# series at zero; tests assert on deltas of ``copy_stats()``.
+# ---------------------------------------------------------------------------
+_copy_lock = threading.Lock()
+# "put" = the plasma zero-copy path (must stay 0); "inline" = joins of
+# sub-threshold values bound for the in-memory store (expected, small);
+# "get" = deserialize copy-outs; "rpc" = RPC body materializations
+_copy_counts: Dict[str, int] = {"put": 0, "inline": 0, "get": 0, "rpc": 0}
+_copy_bytes: Dict[str, int] = {"put": 0, "inline": 0, "get": 0, "rpc": 0}
+_copy_metrics_registered = False
+
+
+def record_payload_copy(path: str, nbytes: int, n: int = 1) -> None:
+    """Record ``n`` intermediate payload copies totalling ``nbytes`` on a
+    data-plane path ("put" | "get" | "rpc")."""
+    with _copy_lock:
+        _copy_counts[path] = _copy_counts.get(path, 0) + n
+        _copy_bytes[path] = _copy_bytes.get(path, 0) + nbytes
+
+
+def copy_stats() -> Dict[str, Dict[str, int]]:
+    """Snapshot of the process-local payload-copy counters."""
+    with _copy_lock:
+        return {
+            "copies": dict(_copy_counts),
+            "bytes": dict(_copy_bytes),
+        }
+
+
+def _ensure_copy_metrics() -> None:
+    """Register the copy counters on the metrics scrape, once. Lazy (first
+    data-plane use) so importing this module never spawns the pusher."""
+    global _copy_metrics_registered
+    if _copy_metrics_registered:
+        return
+    _copy_metrics_registered = True
+    try:
+        from ray_tpu.util.metrics import Metric
+
+        class _CopyCounter(Metric):
+            """Live view over the module counters: zero hot-path cost —
+            the registry reads the dicts only at snapshot time."""
+
+            def __init__(self, name: str, values: Dict[str, int],
+                         description: str):
+                super().__init__(name, description, tag_keys=("path",))
+                self._live = values
+
+            def _snapshot(self) -> dict:
+                with _copy_lock:
+                    series = [{"tags": {"path": k}, "value": float(v)}
+                              for k, v in self._live.items()]
+                return {"name": self._name, "type": "counter",
+                        "description": self._description, "series": series}
+
+        _CopyCounter(
+            "ray_tpu_payload_copies", _copy_counts,
+            "Intermediate payload-byte copies on the data plane")
+        _CopyCounter(
+            "ray_tpu_payload_copy_bytes", _copy_bytes,
+            "Intermediate payload bytes copied on the data plane")
+    except Exception:  # noqa: BLE001 — metrics must never break the data plane
+        pass
 
 # Thread-local collector: while active, every ObjectRef pickled through
 # serialize() is recorded so callers can pin/borrow-register contained
@@ -94,32 +173,127 @@ def _device_to_host(obj: Any) -> Any:
     return obj
 
 
-def serialize(value: Any) -> bytes:
-    """Serialize a Python value into the wire/object-store format."""
+class SerializedValue:
+    """The two-phase serialization handle: pickled meta plus BORROWED
+    zero-copy views of the out-of-band payload buffers (they alias the
+    caller's live arrays — write/consume before mutating the source).
+
+    ``write_into`` lays the wire format into a destination mapping in one
+    pass (the plasma write-in-place path); ``segments`` exposes the frame
+    as a list of buffer segments for vectored socket writes; ``to_bytes``
+    is the counted legacy join."""
+
+    __slots__ = ("meta", "_pickle_buffers", "buffers", "total")
+
+    def __init__(self, meta: bytes, pickle_buffers: List[pickle.PickleBuffer]):
+        self.meta = meta
+        self._pickle_buffers = pickle_buffers
+        self.buffers: List[memoryview] = []
+        total = 8 + len(meta)
+        for b in pickle_buffers:
+            raw = b.raw()
+            if raw.ndim != 1 or raw.format != "B":
+                raw = raw.cast("B")
+            self.buffers.append(raw)
+            total += 8 + raw.nbytes
+        self.total = total
+
+    @property
+    def payload_nbytes(self) -> int:
+        return sum(b.nbytes for b in self.buffers)
+
+    def segments(self) -> List["bytes | memoryview"]:
+        """The wire frame as an ordered list of buffer segments (no
+        payload concatenation)."""
+        segs: List[Any] = [
+            struct.pack("<I", len(self.meta)),
+            self.meta,
+            struct.pack("<I", len(self.buffers)),
+        ]
+        for b in self.buffers:
+            segs.append(struct.pack("<Q", b.nbytes))
+            segs.append(b)
+        return segs
+
+    def write_into(self, dest: memoryview) -> int:
+        """Single-pass copy-free layout into ``dest`` (length >= .total):
+        payload bytes move exactly once, source buffer → dest. Returns the
+        number of bytes written."""
+        off = 0
+        struct.pack_into("<I", dest, off, len(self.meta))
+        off += 4
+        dest[off: off + len(self.meta)] = self.meta
+        off += len(self.meta)
+        struct.pack_into("<I", dest, off, len(self.buffers))
+        off += 4
+        for b in self.buffers:
+            struct.pack_into("<Q", dest, off, b.nbytes)
+            off += 8
+            dest[off: off + b.nbytes] = b
+            off += b.nbytes
+        return off
+
+    def to_bytes(self, copy_path: Optional[str] = "put") -> bytes:
+        """Materialize the frame as one bytes object (the legacy join) —
+        counted as an intermediate payload copy when out-of-band buffers
+        exist."""
+        payload = self.payload_nbytes
+        if payload and copy_path:
+            record_payload_copy(copy_path, payload, n=len(self.buffers))
+        out = bytearray(self.total)
+        self.write_into(memoryview(out))
+        return bytes(out)
+
+    def release(self) -> None:
+        """Release the borrowed buffer views (call after the frame has
+        been written; the handle must not be used afterwards)."""
+        for mv in self.buffers:
+            try:
+                mv.release()
+            except Exception:  # noqa: BLE001
+                pass
+        self.buffers = []
+        for b in self._pickle_buffers:
+            try:
+                b.release()
+            except Exception:  # noqa: BLE001
+                pass
+        self._pickle_buffers = []
+
+
+def serialize_prepare(value: Any) -> SerializedValue:
+    """Phase one of the zero-copy put path: pickle once, keep the payload
+    as borrowed views instead of joining bytes."""
+    _ensure_copy_metrics()
     buffers: List[pickle.PickleBuffer] = []
     bio = io.BytesIO()
     pickler = _CustomPickler(bio, protocol=5, buffer_callback=buffers.append)
     pickler.dump(value)
-    meta = bio.getvalue()
-    out = io.BytesIO()
-    out.write(struct.pack("<I", len(meta)))
-    out.write(meta)
-    out.write(struct.pack("<I", len(buffers)))
-    for b in buffers:
-        raw = b.raw()
-        out.write(struct.pack("<Q", raw.nbytes))
-        out.write(raw)
-        b.release()
-    return out.getvalue()
+    return SerializedValue(bio.getvalue(), buffers)
+
+
+def serialize(value: Any) -> bytes:
+    """Serialize a Python value into the wire/object-store format as one
+    bytes object (joins out-of-band payload — counted; prefer
+    ``serialize_prepare`` + ``write_into`` on hot paths)."""
+    sv = serialize_prepare(value)
+    try:
+        return sv.to_bytes()
+    finally:
+        sv.release()
 
 
 def serialize_into(value: Any, alloc: Callable[[int], memoryview]) -> memoryview:
-    """Serialize directly into store-provided memory (one copy, no interim
-    bytes join for the buffer region when possible)."""
-    data = serialize(value)
-    mv = alloc(len(data))
-    mv[: len(data)] = data
-    return mv
+    """Serialize directly into store-provided memory: the allocation is
+    sized AFTER pickling (phase one), then payload bytes move exactly once
+    into the provided mapping."""
+    sv = serialize_prepare(value)
+    try:
+        mv = alloc(sv.total)
+        sv.write_into(mv)
+        return mv
+    finally:
+        sv.release()
 
 
 # Python-level buffer protocol (PEP 688 ``__buffer__``) only exists on
@@ -190,6 +364,7 @@ def deserialize(data: "bytes | memoryview", release_cb: Optional[Callable] = Non
                 # tracked zero-copy wrapper is invisible to consumers
                 # (np.frombuffer raises). Copy the slice; the pin then
                 # releases in the finally below instead of at value GC.
+                record_payload_copy("get", blen)
                 buffers.append(bytes(sl))
             off += blen
         return pickle.loads(

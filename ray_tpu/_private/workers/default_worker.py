@@ -376,10 +376,12 @@ def _execute_callable_body(
             values = list(result)
             if len(values) != num_returns:
                 raise ValueError(f"expected {num_returns} return values, got {len(values)}")
+        from ray_tpu._private.serialization import serialize_prepare
+
         returns = []
         for i, v in enumerate(values):
             with collect_object_refs() as col:
-                data = serialize(v)
+                sv = serialize_prepare(v)
             # refs nested in the return value: register the CALLER as
             # borrower with each owner BEFORE replying, while our own
             # refs still pin the objects (reference_counter.h:44 —
@@ -408,21 +410,29 @@ def _execute_callable_body(
                         all_borrows.append(entry)
                     except Exception:
                         pass
-            if len(data) <= config.object_store_inline_max_bytes:
-                returns.append({"kind": "inline", "data": data, "borrows": borrows})
-            else:
-                oid = ObjectID.from_index(task_id, i + 1)
-                w.core._plasma_put_with_backpressure(oid, data)
-                # big returns bypass put_serialized, so the bus event is
-                # recorded here (executor-side, gated on the activated
-                # trace context like every worker event)
-                if obs_tracing.active():
-                    obs_events.record_event(
-                        "object_put", size=len(data),
-                        job_id=w.core.job_id.hex(), inline=False)
-                returns.append(
-                    {"kind": "plasma", "node_id": w.core.node_id, "borrows": borrows}
-                )
+            try:
+                if sv.total <= config.object_store_inline_max_bytes:
+                    returns.append({"kind": "inline",
+                                    "data": sv.to_bytes(copy_path="inline"),
+                                    "borrows": borrows})
+                else:
+                    oid = ObjectID.from_index(task_id, i + 1)
+                    # big returns go straight into the reserved mapping
+                    # (Create → write-in-place → Seal): 0 payload copies
+                    w.core._plasma_put_segments(oid, sv)
+                    # big returns bypass put_serialized, so the bus event is
+                    # recorded here (executor-side, gated on the activated
+                    # trace context like every worker event)
+                    if obs_tracing.active():
+                        obs_events.record_event(
+                            "object_put", size=sv.total,
+                            job_id=w.core.job_id.hex(), inline=False)
+                    returns.append(
+                        {"kind": "plasma", "node_id": w.core.node_id,
+                         "borrows": borrows}
+                    )
+            finally:
+                sv.release()
         return {"returns": returns}
     except BaseException as e:  # noqa: BLE001
         tb = traceback.format_exc()
@@ -472,25 +482,32 @@ def _execute_streaming(
                 trace_ctx, name=name,
                 kind="actor_task" if actor_id else "task",
                 attrs={"task_id": task_id.hex(), "streaming": True}):
+            from ray_tpu._private.serialization import serialize_prepare
+
             for value in fn(*args, **kwargs):
-                data = serialize(value)
-                if len(data) <= config.object_store_inline_max_bytes:
-                    rep = client.call(
-                        "StreamingYield", task_id_bin=task_id.binary(),
-                        index=idx, kind="inline", data=data, timeout=60,
-                    )
-                else:
-                    oid = ObjectID.from_index(task_id, idx + 1)
-                    w.core._plasma_put_with_backpressure(oid, data)
-                    if obs_tracing.active():
-                        obs_events.record_event(
-                            "object_put", size=len(data),
-                            job_id=w.core.job_id.hex(), inline=False)
-                    rep = client.call(
-                        "StreamingYield", task_id_bin=task_id.binary(),
-                        index=idx, kind="plasma", node_id=w.core.node_id,
-                        timeout=60,
-                    )
+                sv = serialize_prepare(value)
+                try:
+                    if sv.total <= config.object_store_inline_max_bytes:
+                        rep = client.call(
+                            "StreamingYield", task_id_bin=task_id.binary(),
+                            index=idx, kind="inline",
+                            data=sv.to_bytes(copy_path="inline"),
+                            timeout=60,
+                        )
+                    else:
+                        oid = ObjectID.from_index(task_id, idx + 1)
+                        w.core._plasma_put_segments(oid, sv)
+                        if obs_tracing.active():
+                            obs_events.record_event(
+                                "object_put", size=sv.total,
+                                job_id=w.core.job_id.hex(), inline=False)
+                        rep = client.call(
+                            "StreamingYield", task_id_bin=task_id.binary(),
+                            index=idx, kind="plasma", node_id=w.core.node_id,
+                            timeout=60,
+                        )
+                finally:
+                    sv.release()
                 if not (rep or {}).get("ok", True):
                     break  # consumer abandoned the stream — stop producing
                 idx += 1

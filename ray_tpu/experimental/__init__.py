@@ -5,6 +5,8 @@ from ray_tpu.experimental.channel import (
     Channel,
     ChannelReader,
     ChannelTimeoutError,
+    ChunkPipe,
+    ChunkPipeReader,
     TensorChannel,
     TensorChannelReader,
 )
@@ -13,6 +15,8 @@ __all__ = [
     "Channel",
     "ChannelReader",
     "ChannelTimeoutError",
+    "ChunkPipe",
+    "ChunkPipeReader",
     "TensorChannel",
     "TensorChannelReader",
 ]

@@ -57,20 +57,35 @@ class _Endpoint:
         else:
             self._shm = shared_memory.SharedMemory(name=name)
         self._owner = create
+        # u64 view over the header: ~3x faster than struct.unpack_from
+        # per access, and the seqlock protocol reads the header in every
+        # spin iteration
+        self._hu = self._shm.buf[: self._hdr].cast("Q")
 
-    # -- header accessors ----------------------------------------------
+    # -- header accessors (word-indexed) --------------------------------
     def _get(self, off: int) -> int:
-        return _U64.unpack_from(self._shm.buf, off)[0]
+        return self._hu[off >> 3]
 
     def _put(self, off: int, v: int) -> None:
-        _U64.pack_into(self._shm.buf, off, v)
+        self._hu[off >> 3] = v
 
     @property
     def _seq(self) -> int:
-        return self._get(0)
+        return self._hu[0]
+
+    def _release_views(self) -> None:
+        """Drop cached views of the mapping so shm.close() can succeed
+        (exported pointers block the munmap)."""
+        hu, self._hu = self._hu, None
+        if hu is not None:
+            try:
+                hu.release()
+            except Exception:  # noqa: BLE001
+                pass
 
     def close(self) -> None:
         try:
+            self._release_views()
             self._shm.close()
             if self._owner:
                 self._shm.unlink()
@@ -210,27 +225,66 @@ class Channel(_Endpoint):
 class TensorChannelReader(ChannelReader):
     def __init__(self, name: str, shape, dtype: str, num_readers: int,
                  reader_index: int):
+        import numpy as np
+
         self.shape = tuple(shape)
         self.dtype = dtype
         super().__init__(name, _tensor_nbytes(shape, dtype), num_readers,
                          reader_index)
+        # the slot view is position-independent: build it once, not per read
+        self._slot = np.ndarray(self.shape, self.dtype,
+                                buffer=self._shm.buf, offset=self._hdr)
+        self._borrowed = False
 
     def read(self, timeout: Optional[float] = 10.0):
         """Returns a fresh ndarray (copied out of the slot — the writer
         reuses it immediately after the ack)."""
         import numpy as np
 
+        self._end_borrow()
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             seq = self._await_next(deadline, timeout)
-            view = np.ndarray(self.shape, self.dtype,
-                              buffer=self._shm.buf, offset=self._hdr)
-            out = view.copy()
+            out = np.copy(self._slot)
             if self._seq == seq:  # seqlock re-check: no concurrent write
                 break
         self._last = seq
         self._put(16 + 8 * self.reader_index, seq)
         return out
+
+    def read_view(self, timeout: Optional[float] = 10.0):
+        """Zero-copy borrowed read: returns a READ-ONLY view of the slot
+        itself. The view is valid until ``release()`` (or the next
+        read/read_view, which releases implicitly); the writer cannot
+        overwrite the slot while the borrow is outstanding because the
+        ack is withheld. This is the copy-free consumption path the
+        pipelined collectives use (reduce directly out of shared memory);
+        ``read()`` remains the safe owning-copy default."""
+        self._end_borrow()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        seq = self._await_next(deadline, timeout)
+        # no re-check needed: the writer blocks on our ack before the
+        # next write, so the slot is stable until release()
+        self._last = seq
+        self._borrowed = True
+        view = self._slot.view()
+        view.flags.writeable = False
+        return view
+
+    def release(self) -> None:
+        """Ack the borrowed slot from read_view(), letting the writer
+        reuse it. The borrowed view must no longer be read."""
+        self._end_borrow()
+
+    def _end_borrow(self) -> None:
+        if self._borrowed:
+            self._borrowed = False
+            self._put(16 + 8 * self.reader_index, self._last)
+
+    def close(self) -> None:
+        self._end_borrow()  # ack an outstanding read_view borrow
+        self._slot = None
+        super().close()
 
     def __reduce__(self):
         return (TensorChannelReader, (self.name, self.shape, self.dtype,
@@ -249,23 +303,31 @@ class TensorChannel(Channel):
         self.dtype = str(np.dtype(dtype))
         super().__init__(_tensor_nbytes(shape, dtype), num_readers, name,
                          _attach)
+        self._slot = np.ndarray(self.shape, self.dtype,
+                                buffer=self._shm.buf, offset=self._hdr)
 
     def write(self, arr, timeout: Optional[float] = 10.0) -> None:
         import numpy as np
 
-        arr = np.ascontiguousarray(arr)
-        if arr.shape != self.shape or str(arr.dtype) != self.dtype:
-            raise ValueError(
-                f"expected {self.shape} {self.dtype}, got "
-                f"{arr.shape} {arr.dtype}")
+        if getattr(arr, "shape", None) != self.shape \
+                or str(getattr(arr, "dtype", "")) != self.dtype:
+            arr = np.asarray(arr)
+            if arr.shape != self.shape or str(arr.dtype) != self.dtype:
+                raise ValueError(
+                    f"expected {self.shape} {self.dtype}, got "
+                    f"{arr.shape} {arr.dtype}")
         seq = self._seq
         self._await_acks(seq, timeout)
         self._put(0, seq + 1)  # odd: write in progress
-        dest = np.ndarray(self.shape, self.dtype,
-                          buffer=self._shm.buf, offset=self._hdr)
-        dest[...] = arr
-        self._put(8, arr.nbytes)
+        # copyto handles non-contiguous sources directly: exactly one
+        # payload copy, source array → shared memory
+        np.copyto(self._slot, arr)
+        self._put(8, self._slot.nbytes)
         self._put(0, seq + 2)  # even: release
+
+    def close(self) -> None:
+        self._slot = None
+        super().close()
 
     def reader(self, reader_index: int = 0) -> TensorChannelReader:
         if not 0 <= reader_index < self.num_readers:
@@ -278,3 +340,171 @@ class TensorChannel(Channel):
     def __reduce__(self):
         return (TensorChannel, (self.shape, self.dtype, self.num_readers,
                                 self.name, True))
+
+
+# ---------------------------------------------------------------------------
+# ChunkPipe — double-buffered byte-chunk transport for PIPELINED
+# collectives. A pipe is ``num_slots`` independent seqlock slots of
+# ``chunk_bytes`` each in one shm segment; the writer round-robins the
+# slots, so chunk k+1 is in flight while the consumer still reduces
+# chunk k straight out of slot k (transport/compute overlap with zero
+# reader-side copies). Shape-independent: one pipe per ring edge serves
+# every tensor the group ever reduces.
+# ---------------------------------------------------------------------------
+_SLOT_HDR = 24  # [seq u64][len u64][ack u64] — single reader per pipe
+
+
+class _PipeBase:
+    def __init__(self, name: str, chunk_bytes: int, num_slots: int,
+                 create: bool):
+        self.name = name
+        self.chunk_bytes = chunk_bytes
+        self.num_slots = num_slots
+        self._stride = _SLOT_HDR + chunk_bytes
+        size = self._stride * num_slots
+        if create:
+            self._shm = shared_memory.SharedMemory(
+                name=name, create=True, size=size)
+            # fresh POSIX shm is zero-filled by ftruncate; zero only the
+            # slot headers defensively (multi-MiB payload memset wasted)
+            for i in range(num_slots):
+                off = i * self._stride
+                self._shm.buf[off: off + _SLOT_HDR] = b"\x00" * _SLOT_HDR
+        else:
+            self._shm = shared_memory.SharedMemory(name=name)
+        self._owner = create
+        # one u64 header view per slot (cast views beat struct.unpack
+        # in the spin loops), plus one payload view per slot
+        self._hu = [
+            self._shm.buf[i * self._stride: i * self._stride + _SLOT_HDR
+                          ].cast("Q")
+            for i in range(num_slots)
+        ]
+        self._payload = [
+            self._shm.buf[i * self._stride + _SLOT_HDR:
+                          (i + 1) * self._stride]
+            for i in range(num_slots)
+        ]
+        self._count = 0  # monotonically increasing chunk counter
+
+    @staticmethod
+    def _spin(cond, deadline: Optional[float], what: str):
+        """Pipe waits are SHORT (a peer's chunk memcpy, tens to hundreds
+        of µs): spin, then yield the core (sched_yield keeps the peer
+        process fed on oversubscribed hosts), then short capped naps —
+        the 0.4 ms naps of the generic channels overshoot every chunk
+        and halve delivered pipeline bandwidth."""
+        spins = 0
+        nap = 0.00005
+        while not cond():
+            spins += 1
+            if spins > 4000:
+                if deadline is not None and time.monotonic() > deadline:
+                    raise ChannelTimeoutError(what)
+                time.sleep(nap)
+                nap = min(nap * 2, 0.0002)
+            elif spins % 200 == 0:
+                time.sleep(0)  # yield to the peer on a saturated host
+                if deadline is not None and time.monotonic() > deadline:
+                    raise ChannelTimeoutError(what)
+
+    def close(self) -> None:
+        try:
+            views, self._hu, self._payload = \
+                (self._hu or []) + (self._payload or []), None, None
+            for v in views:
+                try:
+                    v.release()
+                except Exception:  # noqa: BLE001
+                    pass
+            self._shm.close()
+            if self._owner:
+                self._shm.unlink()
+        except Exception:  # noqa: BLE001
+            pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class ChunkPipe(_PipeBase):
+    """Writer endpoint. ``write_chunk`` blocks only when every slot is
+    still un-acked — with the default two slots the transport of one
+    chunk overlaps the consumer's reduce of the previous one."""
+
+    def __init__(self, chunk_bytes: int, num_slots: int = 2,
+                 name: Optional[str] = None, _attach: bool = False):
+        import uuid
+
+        name = name or f"rtpipe_{uuid.uuid4().hex[:12]}"
+        super().__init__(name, chunk_bytes, num_slots, create=not _attach)
+
+    def write_chunk(self, data, timeout: Optional[float] = 10.0) -> None:
+        """Copy ``data`` (buffer-protocol, <= chunk_bytes) into the next
+        slot; exactly one payload copy, source → shared memory."""
+        mv = data if isinstance(data, memoryview) else memoryview(data)
+        if mv.nbytes > self.chunk_bytes:
+            raise ValueError(
+                f"chunk of {mv.nbytes}B exceeds pipe chunk size "
+                f"{self.chunk_bytes}B")
+        slot = self._count % self.num_slots
+        h = self._hu[slot]
+        seq = h[0]
+        deadline = None if timeout is None else time.monotonic() + timeout
+        # previous value in this slot must be consumed (ack == seq)
+        self._spin(lambda: h[2] >= seq, deadline,
+                   f"pipe reader did not consume slot {slot} "
+                   f"within {timeout}s")
+        h[0] = seq + 1  # odd: write in progress
+        if mv.format != "B" or mv.ndim != 1:
+            mv = mv.cast("B")
+        self._payload[slot][: mv.nbytes] = mv
+        h[1] = mv.nbytes
+        h[0] = seq + 2  # even: release
+        self._count += 1
+
+    def __reduce__(self):
+        return (ChunkPipe, (self.chunk_bytes, self.num_slots, self.name,
+                            True))
+
+
+class ChunkPipeReader(_PipeBase):
+    """Reader endpoint; strict borrow discipline:
+
+        view = r.next_chunk()   # zero-copy view of the slot payload
+        ... consume (reduce/copy out of shared memory) ...
+        r.release_chunk()       # ack — the writer may now reuse the slot
+    """
+
+    def __init__(self, name: str, chunk_bytes: int, num_slots: int = 2):
+        super().__init__(name, chunk_bytes, num_slots, create=False)
+        self._borrowed: Optional[int] = None
+
+    def next_chunk(self, timeout: Optional[float] = 10.0) -> memoryview:
+        assert self._borrowed is None, "previous chunk not released"
+        slot = self._count % self.num_slots
+        h = self._hu[slot]
+        last = self._last_seq(slot)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        self._spin(lambda: h[0] > last and h[0] % 2 == 0, deadline,
+                   f"no chunk in slot {slot} within {timeout}s")
+        self._borrowed = slot
+        return self._payload[slot][: h[1]]
+
+    def _last_seq(self, slot: int) -> int:
+        # the ack we last published for this slot IS the last seq consumed
+        return self._hu[slot][2]
+
+    def release_chunk(self) -> None:
+        slot, self._borrowed = self._borrowed, None
+        if slot is not None:
+            h = self._hu[slot]
+            h[2] = h[0]  # ack the seq we just consumed
+            self._count += 1
+
+    def __reduce__(self):
+        return (ChunkPipeReader, (self.name, self.chunk_bytes,
+                                  self.num_slots))

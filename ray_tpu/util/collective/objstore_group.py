@@ -143,11 +143,14 @@ class ObjStoreGroup:
         # fixed-shape metadata channels for the per-op routing agreement
         # (() = not yet set up, None = cross-host: channel plane off)
         self._meta: Any = ()
-        # (enabled, max_bytes) agreed across ALL ranks at first use —
-        # per-rank env knobs must not diverge the per-op exchange keys
-        # (a rank going object-path while peers go channel-path would
-        # deadlock both rendezvous keys)
-        self._policy: Optional[Tuple[bool, int]] = None
+        # ring pipes for LARGE tensors: my pipe feeds my successor, I
+        # read my predecessor's (() = unset, None = cross-host)
+        self._pipes: Any = ()
+        # (enabled, max_bytes, pipe_chunk) agreed across ALL ranks at
+        # first use — per-rank env knobs must not diverge the per-op
+        # exchange keys (a rank going object-path while peers go
+        # channel-path would deadlock both rendezvous keys)
+        self._policy: Optional[Tuple[bool, int, int]] = None
         name = f"__collective_rdv_{group_name}"
         if rank == 0:
             try:
@@ -184,13 +187,13 @@ class ObjStoreGroup:
         raise TimeoutError(f"collective {key} timed out (seq={seq})")
 
     # -- shared-memory channel data plane ------------------------------
-    def _ensure_policy(self) -> Tuple[bool, int]:
+    def _ensure_policy(self) -> Tuple[bool, int, int]:
         """Agree the channel policy ACROSS the group, once: every rank
         contributes its local env knobs, channels activate only when
-        every rank enables them, and the size threshold is the group
-        minimum. The per-op routing decision is then identical on all
-        ranks by construction — divergent env vars degrade throughput,
-        never deadlock the rendezvous."""
+        every rank enables them, and the size threshold / pipeline chunk
+        size are the group minimum. The per-op routing decision is then
+        identical on all ranks by construction — divergent env vars
+        degrade throughput, never deadlock the rendezvous."""
         if self._policy is not None:
             return self._policy
         import os
@@ -202,11 +205,22 @@ class ObjStoreGroup:
                 "RAY_TPU_COLLECTIVE_CHANNEL_MAX_BYTES", str(2 << 20)))
         except ValueError:
             max_bytes = 2 << 20
+        try:
+            pipe_chunk = int(os.environ.get(
+                "RAY_TPU_COLLECTIVE_PIPE_CHUNK_BYTES", str(1 << 20)))
+        except ValueError:
+            pipe_chunk = 1 << 20
+        pipe_chunk = max(4096, pipe_chunk)
         if self.world_size > 1:
-            infos = self._exchange("channel_policy", (enabled, max_bytes))
-            enabled = all(e for e, _ in infos)
-            max_bytes = min(m for _, m in infos)
-        self._policy = (enabled, max_bytes)
+            infos = self._exchange(
+                "channel_policy", (enabled, max_bytes, pipe_chunk))
+            enabled = all(i[0] for i in infos)
+            max_bytes = min(i[1] for i in infos)
+            # older two-field peers can't occur inside one group, but be
+            # defensive: default the chunk when absent
+            pipe_chunk = min(
+                (i[2] if len(i) > 2 else 1 << 20) for i in infos)
+        self._policy = (enabled, max_bytes, pipe_chunk)
         return self._policy
 
     def _make_channel_set(self, shape, dtype, rdv_key: str):
@@ -269,29 +283,30 @@ class ObjStoreGroup:
 
         return zlib.crc32(repr((arr.shape, str(arr.dtype))).encode())
 
-    def _channel_exchange(self, arr: np.ndarray) -> Optional[List[np.ndarray]]:
-        """Write mine once, read every peer's; None = not channelable.
+    def _op_route(self, arr: np.ndarray) -> str:
+        """Decide THIS op's data plane — "channel" (small, per-shape
+        all-to-all seqlock channels), "pipe" (large, chunked pipelined
+        ring), or "object" (rendezvous actor + object store).
 
-        Routing (channel plane vs object path) must be decided
-        IDENTICALLY on every rank, but it depends on per-rank state —
-        the tensor's shape/size and each rank's channel cache. So every
-        op first exchanges (shape-sig, nbytes) over a fixed-shape meta
-        channel (a couple of seqlock shm reads, no actor round-trips)
-        and each rank applies the same rule to the same vector: all
-        metas equal and under the size cap → data channels, anything
-        else → everyone takes the object path. Without the per-op
-        agreement, a rank whose (shape, dtype) is already cached would
-        skip the one-time rendezvous that peers with a DIFFERENT shape
-        are blocked in — mismatched-shape ops after a matching warm-up,
-        or ops straddling the size threshold, would deadlock both sides
-        for the full 120s and desync the exchange seq (advisor
-        finding)."""
-        enabled, max_bytes = self._ensure_policy()
+        The routing must be decided IDENTICALLY on every rank, but it
+        depends on per-rank state — the tensor's shape/size and each
+        rank's channel cache. So every op first exchanges (shape-sig,
+        nbytes) over a fixed-shape meta channel (a couple of seqlock shm
+        reads, no actor round-trips) and each rank applies the same rule
+        to the same vector: all metas equal → size decides channel vs
+        pipe; anything else → everyone takes the object path. Without
+        the per-op agreement, a rank whose (shape, dtype) is already
+        cached would skip the one-time rendezvous that peers with a
+        DIFFERENT shape are blocked in — mismatched-shape ops after a
+        matching warm-up, or ops straddling the size threshold, would
+        deadlock both sides for the full 120s and desync the exchange
+        seq (advisor finding)."""
+        enabled, max_bytes, _ = self._ensure_policy()
         if not enabled:
-            return None  # group-agreed constant: identical on all ranks
+            return "object"  # group-agreed constant: identical everywhere
         meta = self._ensure_meta_channels()
         if meta is None:
-            return None  # cross-host: object path (symmetric on all ranks)
+            return "object"  # cross-host: symmetric on all ranks
         meta_ch, meta_readers = meta
         sig = np.array([self._shape_sig(arr), arr.nbytes], np.int64)
         meta_ch.write(sig, timeout=120.0)
@@ -300,8 +315,15 @@ class ObjStoreGroup:
             peer = rd.read(timeout=120.0)
             if peer[0] != sig[0] or peer[1] != sig[1]:
                 agree = False  # keep reading: drain every peer's slot
-        if not agree or arr.nbytes > max_bytes:
-            return None  # same decision everywhere, by construction
+        if not agree:
+            return "object"  # same decision everywhere, by construction
+        return "channel" if arr.nbytes <= max_bytes else "pipe"
+
+    def _channel_parts(self, arr: np.ndarray) -> Optional[List[np.ndarray]]:
+        """Small-tensor plane: write mine once, read every peer's.
+        None = channel setup detected a shape-signature collision —
+        symmetric on all ranks (the chsetup exchange shows everyone the
+        same mismatch), so every rank falls back together."""
         st = self._ensure_channels(arr.shape, arr.dtype)
         if st is None:
             return None
@@ -316,21 +338,182 @@ class ObjStoreGroup:
             parts[r] = rd.read(timeout=120.0)
         return parts
 
+    # -- pipelined ring plane (large tensors) ---------------------------
+    _PIPE_SLOTS = 4
+
+    def _ensure_pipes(self):
+        """Ring pipes, one per edge: my ChunkPipe feeds my successor
+        (rank+1), I read my predecessor's. Established through one
+        object-path exchange the first time any op routes "pipe" (the
+        routing agreement guarantees every rank arrives); None = the
+        group spans hosts — cached, all ranks fall back together."""
+        if self._pipes != ():
+            return self._pipes
+        import socket
+
+        from ray_tpu.experimental.channel import ChunkPipe, ChunkPipeReader
+
+        _, _, pipe_chunk = self._ensure_policy()
+        host = socket.gethostname()
+        # four slots: enough in-flight chunks to ride out scheduler
+        # jitter on oversubscribed hosts; identical constant on every
+        # rank, so writer/reader slot grids always match
+        mine = ChunkPipe(pipe_chunk, num_slots=self._PIPE_SLOTS)
+        infos = self._exchange("pipesetup", (host, mine.name))
+        if any(h != host for h, _ in infos):
+            mine.close()
+            self._pipes = None
+            return None
+        pred = (self.rank - 1) % self.world_size
+        reader = ChunkPipeReader(infos[pred][1], pipe_chunk,
+                                 num_slots=self._PIPE_SLOTS)
+        self._pipes = (mine, reader)
+        return self._pipes
+
+    def _ring_step(self, mine, pred, send: np.ndarray, recv: np.ndarray,
+                   consume, chunk_elems: int) -> None:
+        """One ring step, chunk-pipelined: transport of chunk k+1
+        overlaps the consume (in-place reduce / copy) of chunk k, and
+        the consume reads straight out of the predecessor's shm slot —
+        zero reader-side copies. ``consume(dst, incoming, lo)`` receives
+        the chunk's element offset so fused reducers can address the
+        matching slice of a sibling buffer."""
+        n_send = -(-send.size // chunk_elems) if send.size else 0
+        n_recv = -(-recv.size // chunk_elems) if recv.size else 0
+        for ci in range(max(n_send, n_recv)):
+            lo = ci * chunk_elems
+            if ci < n_send:
+                mine.write_chunk(
+                    memoryview(send[lo: lo + chunk_elems]), timeout=120.0)
+            if ci < n_recv:
+                dst = recv[lo: lo + chunk_elems]
+                view = pred.next_chunk(timeout=120.0)
+                consume(dst, np.frombuffer(view, dtype=recv.dtype), lo)
+                pred.release_chunk()
+
+    _INPLACE_REDUCERS = {
+        ReduceOp.SUM: np.add,
+        ReduceOp.MEAN: np.add,  # divided by world_size at the end
+        ReduceOp.PRODUCT: np.multiply,
+        ReduceOp.MAX: np.maximum,
+        ReduceOp.MIN: np.minimum,
+    }
+
+    def _pipeline_allreduce(self, arr: np.ndarray,
+                            op: ReduceOp) -> Optional[np.ndarray]:
+        """Chunked ring allreduce (reduce-scatter + allgather) over the
+        double-buffered pipes; None = no pipe plane (cross-host).
+
+        The accumulator starts UNINITIALIZED: in the reduce-scatter
+        phase each rank receives every segment exactly once, so the
+        local contribution is fused into the first (only) touch —
+        ``red(arr_seg, incoming, out=acc_seg)`` reads the input and the
+        shm slot and writes the accumulator in ONE pass, which also
+        removes the full-tensor ``arr.copy()`` from the critical path.
+        Step 0 therefore sends from ``arr`` (original values); later
+        steps send the partially-reduced ``acc`` segments."""
+        pipes = self._ensure_pipes()
+        if pipes is None:
+            return None
+        mine, pred = pipes
+        N = self.world_size
+        _, _, chunk_bytes = self._ensure_policy()
+        op = ReduceOp(op)
+        red = self._INPLACE_REDUCERS[op]
+        flat = arr.reshape(-1)
+        if op in (ReduceOp.SUM, ReduceOp.MEAN, ReduceOp.PRODUCT) \
+                and flat.dtype.kind in "bui":
+            # match the object/channel paths: np.sum/np.prod promote
+            # bool/small-int accumulation to 64-bit — an in-place int8
+            # ring sum would overflow where np.sum does not. Same
+            # promotion on every rank (dtype is meta-agreed), so the
+            # wire dtype stays consistent.
+            flat = flat.astype(
+                np.uint64 if flat.dtype.kind == "u" else np.int64)
+        acc = np.empty_like(flat)
+        chunk_elems = max(1, chunk_bytes // max(1, acc.itemsize))
+        bounds = [(acc.size * i) // N for i in range(N + 1)]
+
+        def seg(buf: np.ndarray, i: int) -> np.ndarray:
+            return buf[bounds[i]: bounds[i + 1]]
+
+        # reduce-scatter: after N-1 steps rank r owns the fully-reduced
+        # segment (r+1) % N
+        for s in range(N - 1):
+            send_idx = (self.rank - s) % N
+            recv_idx = (self.rank - s - 1) % N
+            local = seg(flat, recv_idx)
+
+            def fused(dst, incoming, lo, _local=local):
+                # fold the matching slice of the ORIGINAL input into the
+                # accumulator in the same pass as the incoming chunk
+                red(_local[lo: lo + dst.size], incoming, out=dst)
+
+            self._ring_step(
+                mine, pred,
+                seg(flat if s == 0 else acc, send_idx),
+                seg(acc, recv_idx), fused, chunk_elems)
+        # allgather of the reduced segments
+        for s in range(N - 1):
+            self._ring_step(mine, pred,
+                            seg(acc, (self.rank + 1 - s) % N),
+                            seg(acc, (self.rank - s) % N),
+                            lambda dst, incoming, _lo: np.copyto(dst, incoming),
+                            chunk_elems)
+        if op == ReduceOp.MEAN:
+            acc = acc / N  # true divide: ints promote like np.mean
+        return acc.reshape(arr.shape)
+
+    def _pipeline_allgather(self, arr: np.ndarray) -> Optional[List[np.ndarray]]:
+        """Chunked ring allgather: each rank's tensor circles the ring
+        once, forwarded chunk by chunk."""
+        pipes = self._ensure_pipes()
+        if pipes is None:
+            return None
+        mine, pred = pipes
+        N = self.world_size
+        _, _, chunk_bytes = self._ensure_policy()
+        flat = arr.reshape(-1)
+        chunk_elems = max(1, chunk_bytes // max(1, flat.itemsize))
+        parts: List[Any] = [None] * N
+        parts[self.rank] = flat.copy()  # own part stays an independent copy
+        for s in range(N - 1):
+            send_idx = (self.rank - s) % N
+            recv_idx = (self.rank - s - 1) % N
+            parts[recv_idx] = np.empty_like(flat)
+            self._ring_step(mine, pred, parts[send_idx], parts[recv_idx],
+                            lambda dst, incoming, _lo: np.copyto(dst, incoming),
+                            chunk_elems)
+        return [p.reshape(arr.shape) for p in parts]
+
     def allreduce(self, tensor: Any, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
         arr = np.ascontiguousarray(tensor)
         with _op_span("allreduce", arr.nbytes, self.world_size, self.rank):
-            parts = self._channel_exchange(arr)
-            if parts is None:
-                parts = self._exchange("allreduce", arr)
+            route = self._op_route(arr)
+            if route == "pipe":
+                out = self._pipeline_allreduce(arr, ReduceOp(op))
+                if out is not None:
+                    return out
+            elif route == "channel":
+                parts = self._channel_parts(arr)
+                if parts is not None:
+                    return _NUMPY_REDUCERS[ReduceOp(op)](np.stack(parts))
+            parts = self._exchange("allreduce", arr)
             return _NUMPY_REDUCERS[ReduceOp(op)](np.stack(parts))
 
     def allgather(self, tensor: Any) -> List[np.ndarray]:
         arr = np.ascontiguousarray(tensor)
         with _op_span("allgather", arr.nbytes, self.world_size, self.rank):
-            parts = self._channel_exchange(arr)
-            if parts is None:
-                parts = self._exchange("allgather", arr)
-            return parts
+            route = self._op_route(arr)
+            if route == "pipe":
+                parts = self._pipeline_allgather(arr)
+                if parts is not None:
+                    return parts
+            elif route == "channel":
+                parts = self._channel_parts(arr)
+                if parts is not None:
+                    return parts
+            return self._exchange("allgather", arr)
 
     def reducescatter(self, tensor: Any, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
         red = self.allreduce(tensor, op)
