@@ -424,6 +424,69 @@ def _run_micro_smoke() -> None:
     print("MICRO_SMOKE_JSON " + json.dumps(out))
 
 
+def _probe_tpu(max_attempts: int) -> bool:
+    """Short child-process probe; True only on an affirmative TPU
+    verdict. A completed CPU-only probe is authoritative (no retry)."""
+    env = dict(os.environ, **{_CHILD_ENV: "probe"})
+    for attempt in range(max_attempts):
+        clean_verdict = False
+        ok = False
+        try:
+            probe = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, capture_output=True, text=True, timeout=240,
+            )
+            clean_verdict = "PROBE_OK" in probe.stdout
+            ok = clean_verdict and "platform=tpu" in probe.stdout
+        except subprocess.TimeoutExpired:
+            ok = False
+        if ok:
+            return True
+        if clean_verdict:
+            return False  # "no TPU here" is a verdict, not a flake
+        print(f"# TPU probe attempt {attempt + 1} failed/hung",
+              file=sys.stderr)
+    return False
+
+
+_LAST_TPU_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_LAST_TPU.json")
+
+
+def _record_last_tpu(line: str) -> None:
+    """A fresh TPU headline: persist as the last-known-good number."""
+    try:
+        with open(_LAST_TPU_PATH, "w") as f:
+            json.dump({
+                "headline": json.loads(line),
+                "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                             time.gmtime()),
+                "stale": False,
+            }, f, indent=1)
+    except (OSError, ValueError) as e:
+        print(f"# could not record BENCH_LAST_TPU.json: {e}",
+              file=sys.stderr)
+
+
+def _carry_stale_tpu() -> None:
+    """No TPU this window: re-mark the recorded last-known-good number
+    stale and echo it into the tail, so a CPU-only round still carries
+    the most recent real TPU figure (clearly labeled, never mistaken
+    for a fresh measurement)."""
+    try:
+        with open(_LAST_TPU_PATH) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return  # no TPU number has ever been recorded
+    data["stale"] = True
+    try:
+        with open(_LAST_TPU_PATH, "w") as f:
+            json.dump(data, f, indent=1)
+    except OSError:
+        pass
+    print(f"# last_known_tpu {json.dumps(data)}")
+
+
 def main() -> None:
     if "--micro-smoke" in sys.argv:
         _run_micro_smoke()
@@ -449,32 +512,13 @@ def main() -> None:
     # (round-1 failure mode: it HANGS rather than erroring, so committing
     # to a full-length TPU attempt first risks never printing a number).
     # Bounded init + ONE retry (VERDICT round-6): a transiently-flaky
-    # tunnel gets a second chance before the run is stamped CPU-only.
-    env = dict(os.environ, **{_CHILD_ENV: "probe"})
-    tpu_ok = False
-    for attempt in range(2):
-        clean_verdict = False
-        try:
-            probe = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)],
-                env=env, capture_output=True, text=True, timeout=240,
-            )
-            # a completed probe is authoritative: PROBE_OK platform=cpu
-            # means "no TPU here", not a flake worth retrying
-            clean_verdict = "PROBE_OK" in probe.stdout
-            tpu_ok = clean_verdict and "platform=tpu" in probe.stdout
-        except subprocess.TimeoutExpired:
-            tpu_ok = False
-        if tpu_ok or clean_verdict:
-            break
-        print(f"# TPU probe attempt {attempt + 1} failed/hung",
-              file=sys.stderr)
-    if tpu_ok:
-        attempts = [("tpu", 1200.0), ("cpu", 900.0)]
-    else:
+    # tunnel gets a second chance before the run is stamped CPU-only —
+    # and a LAST re-probe runs at the END of the window (after the CPU
+    # measurements) before the run settles for a CPU headline.
+    tpu_ok = _probe_tpu(max_attempts=2)
+    if not tpu_ok:
         print("# TPU probe found no usable TPU — falling back to CPU; "
               "results are stamped tpu_probe=failed", file=sys.stderr)
-        attempts = [("cpu", 900.0)]
 
     # secondary metrics of record: control-plane ops/s + allreduce GB/s
     # (full detail lands in MICROBENCH.json; compact copies in the tail)
@@ -504,11 +548,34 @@ def main() -> None:
     except (OSError, ValueError):
         pass
 
-    for platform, timeout in attempts:
-        line = _try_child(platform, timeout)
+    if tpu_ok:
+        line = _try_child("tpu", 1200.0)
         if line is not None:
+            _record_last_tpu(line)
             print(line)
             return
+    cpu_line = _try_child("cpu", 900.0)
+    if not tpu_ok:
+        # End-of-window re-probe: a tunnel that was down when the window
+        # opened may be back; one more chance at a REAL TPU number
+        # before settling for CPU (VERDICT item 1, beyond the round-6
+        # single retry).
+        print("# end-of-window TPU re-probe before settling for CPU",
+              file=sys.stderr)
+        if _probe_tpu(max_attempts=1):
+            line = _try_child("tpu", 1200.0)
+            if line is not None:
+                _record_last_tpu(line)
+                print("# late TPU probe succeeded; headline is TPU",
+                      file=sys.stderr)
+                print(line)
+                return
+        # still CPU-only: carry the stale-marked last-known-good TPU
+        # figure into the tail
+        _carry_stale_tpu()
+    if cpu_line is not None:
+        print(cpu_line)
+        return
 
     try:
         _run_bench("cpu")

@@ -41,7 +41,14 @@ _DEFINITIONS: Dict[str, Tuple[type, Any]] = {
     "gcs_storage_path": (str, ""),  # "" = in-memory; else file-backed persistence
     "gcs_health_check_period_ms": (int, 1000),
     "gcs_health_check_timeout_ms": (int, 5000),
-    "gcs_health_check_failure_threshold": (int, 5),
+    # 20 missed beats (~20s) before a node is declared dead. 5 was too
+    # twitchy on an oversubscribed 1-core host: a 2,000-actor burst
+    # starves the raylet process of CPU long enough to gap heartbeats
+    # >10s, and one false death cascades (every actor on the node
+    # fails — observed killing the combined scale phase). The reference
+    # defaults to ~30s of missed heartbeats; tests that kill nodes
+    # budget >=30s for detection, so 20s keeps their margin.
+    "gcs_health_check_failure_threshold": (int, 20),
     "gcs_pubsub_poll_timeout_s": (float, 30.0),
     # --- raylet / scheduler ---
     "raylet_heartbeat_period_ms": (int, 500),
@@ -72,6 +79,12 @@ _DEFINITIONS: Dict[str, Tuple[type, Any]] = {
     "object_pull_chunk_bytes": (int, 8 * 1024**2),
     # --- tasks ---
     "task_max_retries_default": (int, 3),
+    # how long a submitter keeps an idle granted lease warm before
+    # returning it to the raylet. A sync small-task loop previously paid
+    # RequestWorkerLease + SetLeaseContext + ReturnWorkerLease around
+    # EVERY PushTask (~4 control RPCs per call); with keep-alive the warm
+    # path is one worker RPC. 0 restores return-on-idle.
+    "worker_lease_keepalive_s": (float, 0.5),
     # queued same-class tasks pushed to a leased worker per RPC roundtrip
     # (1 = the reference's one-PushTask-per-task behavior)
     "task_push_batch_size": (int, 32),

@@ -443,6 +443,12 @@ class _ActorStateHub:
     def __init__(self, core: "CoreWorker"):
         self.core = core
         self._events: Dict[str, set] = {}  # aid -> set of Events
+        # freshest event payload per WATCHED actor ({state, version,
+        # worker_addr, death_cause}): the event itself resolves the
+        # actor, so a woken waiter usually needs no GetActorInfo
+        # round-trip. Pruned with the watcher set — unwatched actors'
+        # events are never recorded.
+        self.last_event: Dict[str, dict] = {}
         self._seq = 0
         self._task: Optional[asyncio.Task] = None
 
@@ -461,6 +467,7 @@ class _ActorStateHub:
             s.discard(ev)
             if not s:
                 del self._events[aid]
+                self.last_event.pop(aid, None)
 
     async def _loop(self) -> None:
         while self._events and not self.core._shutdown:
@@ -491,8 +498,16 @@ class _ActorStateHub:
                 for s in self._events.values():
                     for ev in s:
                         ev.set()
-            for _seqno, aid, _payload in rep.get("events", ()):
-                for ev in self._events.get(aid, ()):
+            for _seqno, aid, payload in rep.get("events", ()):
+                watchers = self._events.get(aid)
+                if not watchers:
+                    continue
+                if isinstance(payload, dict):
+                    prev = self.last_event.get(aid)
+                    if prev is None or payload.get("version", 0) >= \
+                            prev.get("version", 0):
+                        self.last_event[aid] = payload
+                for ev in watchers:
                     ev.set()
 
 
@@ -530,21 +545,33 @@ class CoreWorker(CoreRuntime):
         self._node_addrs: Dict[str, Tuple[str, int]] = {}
         self._node_addrs_lock = threading.Lock()
 
-        # owner RPC server (GetObject / WaitObject / health)
+        # owner RPC server (GetObject / WaitObject / health). Handlers
+        # that only touch the memory store / pending tables register
+        # inline: they run on the io loop with no executor handoff —
+        # the result-delivery hop of every warm actor call rides these.
         self.server = RpcServer(name=f"core-{self.worker_id_hex[:8]}")
-        self.server.register("GetObject", self._handle_get_object)
-        self.server.register("GetObjectsStatus", self._handle_get_objects_status)
+        self.server.register("GetObject", self._handle_get_object,
+                             inline=True)
+        self.server.register("GetObjectsStatus",
+                             self._handle_get_objects_status, inline=True)
         self.server.register("WaitObject", self._handle_wait_object)
         self.server.register("RecoverObject", self._handle_recover_object)
-        self.server.register("AddBorrower", self._handle_add_borrower)
-        self.server.register("RemoveBorrower", self._handle_remove_borrower)
-        self.server.register("ActorTaskDone", self._handle_actor_task_done)
-        self.server.register("ActorTasksDone", self._handle_actor_tasks_done)
+        self.server.register("AddBorrower", self._handle_add_borrower,
+                             inline=True)
+        self.server.register("RemoveBorrower", self._handle_remove_borrower,
+                             inline=True)
+        self.server.register("ActorTaskDone", self._handle_actor_task_done,
+                             inline=True)
+        self.server.register("ActorTasksDone", self._handle_actor_tasks_done,
+                             inline=True)
         self.server.register("NormalTaskDone", self._handle_normal_task_done)
-        self.server.register("StreamingYield", self._handle_streaming_yield)
-        self.server.register("StreamingDone", self._handle_streaming_done)
-        self.server.register("StreamingCredit", self._handle_streaming_credit)
-        self.server.register("Ping", lambda: "pong")
+        self.server.register("StreamingYield", self._handle_streaming_yield,
+                             inline=True)
+        self.server.register("StreamingDone", self._handle_streaming_done,
+                             inline=True)
+        self.server.register("StreamingCredit",
+                             self._handle_streaming_credit, inline=True)
+        self.server.register("Ping", lambda: "pong", inline=True)
         self.server.start(self.loop_thread)
         self.address: Tuple[str, int] = (self.server.host, self.server.port)
 
@@ -556,6 +583,9 @@ class CoreWorker(CoreRuntime):
         self._lock = threading.Lock()
         self._leases: Dict[Any, List[_LeaseEntry]] = {}  # scheduling_class -> entries
         self._lease_requests_inflight: Dict[Any, int] = {}
+        # keep-alive sweeper for idle granted leases (io-loop task,
+        # armed lazily on the first idle lease)
+        self._lease_sweeper: Optional[asyncio.Task] = None
         # deques: 100k queued tasks must pop O(1), not O(n)
         self._task_queue: Dict[Any, Any] = {}  # sc -> deque[TaskSpec]
         self._pending_tasks: Dict[TaskID, Dict[str, Any]] = {}
@@ -1828,9 +1858,65 @@ class CoreWorker(CoreRuntime):
                         break
                 entry.busy = True
         if not specs:
-            await self._return_lease(sc, entry)
+            # Keep the granted lease WARM instead of returning it: the
+            # next same-class submit then pushes straight to the leased
+            # worker — one worker RPC, no raylet/GCS touch (reference:
+            # normal_task_submitter.cc keeps leased workers for reuse;
+            # ours previously paid RequestWorkerLease + SetLeaseContext
+            # + ReturnWorkerLease around EVERY sync small task). The
+            # sweeper returns it after worker_lease_keepalive_s idle so
+            # held CPU cannot starve other classes for long.
+            if config.worker_lease_keepalive_s <= 0:
+                await self._return_lease(sc, entry)
+                return
+            entry.busy = False
+            entry.last_used = time.monotonic()
+            self._ensure_lease_sweeper()
             return
         await self._push_tasks(specs, entry)
+
+    def _ensure_lease_sweeper(self) -> None:
+        """io-loop only."""
+        if self._lease_sweeper is None or self._lease_sweeper.done():
+            self._lease_sweeper = asyncio.ensure_future(
+                self._lease_sweeper_loop())
+
+    async def _lease_sweeper_loop(self) -> None:
+        """Return idle kept-alive leases to their raylets. Lives while any
+        lease exists; re-armed by the next idle lease after it exits."""
+        while not self._shutdown:
+            keep = max(0.05, config.worker_lease_keepalive_s)
+            await asyncio.sleep(keep / 2)
+            now = time.monotonic()
+            expired: List[Tuple[Any, _LeaseEntry]] = []
+            with self._lock:
+                for sc, entries in list(self._leases.items()):
+                    if self._task_queue.get(sc):
+                        continue  # queued work will claim these
+                    for e in list(entries):
+                        if not e.busy and now - e.last_used > keep:
+                            entries.remove(e)
+                            expired.append((sc, e))
+                    if not entries:
+                        self._leases.pop(sc, None)
+                alive = any(self._leases.values())
+            for _sc, e in expired:
+                try:
+                    await self._lease_raylet(e).acall(
+                        "ReturnWorkerLease", lease_id=e.lease_id)
+                except Exception as exc:  # noqa: BLE001
+                    if not self._shutdown:
+                        logger.debug("keepalive lease return %s failed: %s",
+                                     e.lease_id[:8], exc)
+            if not alive:
+                # re-check under the lock: a lease that went idle while
+                # the returns above were in flight would otherwise never
+                # be swept (_ensure_lease_sweeper saw us still running),
+                # pinning its worker for the driver's lifetime
+                with self._lock:
+                    alive = any(self._leases.values())
+                if not alive:
+                    return
 
     async def _return_lease(self, sc, entry: _LeaseEntry) -> None:
         with self._lock:
@@ -2403,13 +2489,23 @@ class CoreWorker(CoreRuntime):
         ev = self._actor_hub.watch(actor_id_hex)
         try:
             while time.monotonic() < deadline:
-                try:
-                    info = await self.gcs.acall(
-                        "GetActorInfo", actor_id=actor_id_hex, timeout=15)
-                except (RpcConnectionError, ConnectionError, OSError,
-                        TimeoutError):
-                    await asyncio.sleep(0.5)
-                    continue
+                # warm path: the hub's freshest pushed event already
+                # carries state + address — resolve from it with NO
+                # GetActorInfo round-trip (the 2,000-actor burst then
+                # costs one GCS query per actor, not one per wake)
+                info = self._actor_hub.last_event.get(actor_id_hex)
+                if not (info and (
+                        (info.get("state") == "ALIVE"
+                         and info.get("worker_addr"))
+                        or info.get("state") == "DEAD")):
+                    try:
+                        info = await self.gcs.acall(
+                            "GetActorInfo", actor_id=actor_id_hex,
+                            timeout=15)
+                    except (RpcConnectionError, ConnectionError, OSError,
+                            TimeoutError):
+                        await asyncio.sleep(0.5)
+                        continue
                 if info is None:
                     raise ActorDiedError(
                         f"Actor {actor_id_hex[:12]} does not exist")

@@ -1,0 +1,155 @@
+"""Control-plane codec fast path: native C extension with a byte-identical
+pure-Python fallback.
+
+Reference analogue: the reference executes its per-call hot loop in C++
+with the GIL dropped (src/ray/_raylet.pyx:2942, src/ray/rpc/); this module
+is that native layer for the frame/codec work of ray_tpu's Python control
+plane. Consumers import the module-level functions — whichever backend won
+selection at import time is transparent:
+
+    pack_header / unpack_header     RPC frame header ([u32][u64][u8])
+    encode_body / decode_body       out-of-band body framing
+    write_body_into                 single-pass frame layout into a mapping
+                                    (GIL-released memcpy on the C backend)
+    build_frame                     header + small body in one allocation
+    id_from_index                   ObjectID::FromIndex derivation
+
+Selection (``RAY_TPU_FASTPATH``):
+    unset / "1" / "auto"  build+load the C extension if a compiler is
+                          available; silently fall back to Python otherwise
+    "0"                   force the pure-Python fallback
+    "require"             fail loudly if the C extension cannot load
+                          (CI guard against silent fallback)
+
+The build is make-driven (src/fastpath/Makefile, same pattern as
+src/object_store) into ``_build/`` next to this file, serialized across
+processes with an flock so a cluster boot (driver + gcs + raylet + workers
+importing concurrently) compiles once.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import sysconfig
+
+from ray_tpu._private.fastpath import _pyimpl
+
+logger = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_DIR, "_build")
+# ABI-tagged filename + built with THIS interpreter's headers: a 3.10
+# venv and a 3.13 system python keep separate extensions — loading a
+# mismatched ABI would be undefined behavior, not an ImportError
+_SO_PATH = os.path.join(
+    _BUILD_DIR,
+    "ray_tpu_fastpath" + (sysconfig.get_config_var("EXT_SUFFIX") or ".so"))
+
+
+def _repo_src_dir() -> str:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(_DIR)))
+    return os.path.join(root, "src", "fastpath")
+
+
+def _needs_build(src: str) -> bool:
+    if not os.path.exists(src):
+        return False  # installed without sources: use what exists
+    return not os.path.exists(_SO_PATH) or (
+        os.path.getmtime(_SO_PATH) < os.path.getmtime(src)
+    )
+
+
+def _build_locked() -> bool:
+    """Build the extension under an flock (many processes import this
+    module at cluster boot; exactly one compiles)."""
+    src_dir = _repo_src_dir()
+    src = os.path.join(src_dir, "fastpath.c")
+    if not _needs_build(src):
+        return os.path.exists(_SO_PATH)
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    lock_path = os.path.join(_BUILD_DIR, ".build.lock")
+    try:
+        import fcntl
+
+        with open(lock_path, "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            try:
+                if _needs_build(src):  # re-check: the lock winner built it
+                    subprocess.run(
+                        ["make", "-C", src_dir,
+                         f"PYTHON={sys.executable}"],
+                        check=True, capture_output=True, timeout=120,
+                    )
+            finally:
+                fcntl.flock(lock, fcntl.LOCK_UN)
+    except Exception as e:  # noqa: BLE001 — no compiler, make missing, ...
+        logger.debug("fastpath build failed (%s); using Python fallback", e)
+        return os.path.exists(_SO_PATH)
+    return os.path.exists(_SO_PATH)
+
+
+def _load_c():
+    """Load the ABI-tagged extension from _build/."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("ray_tpu_fastpath", _SO_PATH)
+    if spec is None or spec.loader is None:
+        raise ImportError(f"cannot load {_SO_PATH}")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _select():
+    mode = os.environ.get("RAY_TPU_FASTPATH", "auto").strip().lower()
+    if mode in ("0", "false", "off", "python"):
+        return _pyimpl
+    try:
+        if _build_locked():
+            return _load_c()
+        raise ImportError("fastpath extension not built")
+    except Exception as e:  # noqa: BLE001
+        if mode == "require":
+            raise ImportError(
+                f"RAY_TPU_FASTPATH=require but the C extension is "
+                f"unavailable: {e}"
+            ) from e
+        logger.debug("fastpath C backend unavailable (%s); using Python", e)
+        return _pyimpl
+
+
+_impl = _select()
+
+BACKEND: str = _impl.BACKEND
+NOGIL_THRESHOLD: int = _impl.NOGIL_THRESHOLD
+pack_header = _impl.pack_header
+unpack_header = _impl.unpack_header
+encode_body = _impl.encode_body
+decode_body = _impl.decode_body
+write_body_into = _impl.write_body_into
+build_frame = _impl.build_frame
+id_from_index = _impl.id_from_index
+
+
+def backend() -> str:
+    """"c" when the native extension serves the hot loop, else "python"."""
+    return BACKEND
+
+
+def available_backends() -> dict:
+    """name -> impl module, for the parity test. The Python fallback is
+    always present; "c" appears when the extension can load (built here
+    if a compiler exists)."""
+    out = {"python": _pyimpl}
+    if BACKEND == "c":
+        out["c"] = _impl
+    else:
+        try:
+            if _build_locked():
+                out["c"] = _load_c()
+        except Exception:  # noqa: BLE001 — parity test skips the C half
+            pass
+    return out

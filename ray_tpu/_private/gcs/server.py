@@ -141,7 +141,12 @@ class GcsServer:
         self.autoscaler_enabled_until = 0.0
         self._dirty = False
         self._needs_replay_reschedule = False
-        self._actor_create_gate = None  # asyncio.Semaphore, loop-affine
+        # per-NODE creation gates (asyncio.Semaphore, loop-affine): the
+        # admission bound on in-flight lease+spawn+CreateActor pipelines
+        # scales with the cluster instead of throttling a multi-node
+        # burst to one node's budget
+        self._actor_create_gates: Dict[str, Any] = {}
+        self._last_prestart = 0.0
         self._wal = None  # lazily-opened append handle
         self._wal_records = 0
         self._wal_degraded = False  # an append failed since last compact
@@ -513,12 +518,65 @@ class GcsServer:
             if self._wal_records or self._dirty:
                 self._compact()
 
+    def _loop_handle(self):
+        """Clients bound to the GCS's OWN event loop (rpc.LoopHandle):
+        an ``acall`` from a handler runs in-line instead of paying two
+        cross-thread handoffs to the global client loop per control
+        RPC — on a 1-core host that is a measurable slice of every
+        actor-creation pipeline."""
+        from ray_tpu._private.rpc import LoopHandle
+
+        h = getattr(self, "_loop_handle_cached", None)
+        if h is None or h.loop is not asyncio.get_event_loop():
+            h = self._loop_handle_cached = LoopHandle(
+                asyncio.get_event_loop())
+        return h
+
     def _raylet(self, node_id: str) -> RpcClient:
         c = self._raylet_clients.get(node_id)
         if c is None:
             node = self.nodes[node_id]
-            c = RpcClient(node.address[0], node.address[1])
+            c = RpcClient(node.address[0], node.address[1],
+                          self._loop_handle())
             self._raylet_clients[node_id] = c
+        return c
+
+    _WORKER_CLIENT_CACHE_MAX = 128
+
+    def _worker_client(self, addr: Tuple[str, int]) -> RpcClient:
+        """LRU-bounded worker connections (CreateActor / KillActor):
+        creation previously opened + tore down a fresh TCP connection
+        per actor — connect latency inside every gated pipeline slot and
+        fd churn at 2k-actor bursts. Bounded so a 40k-actor lifetime
+        cannot pin 40k sockets; evicted (and dead-worker) clients close
+        asynchronously and a later use simply reconnects."""
+        cache = getattr(self, "_worker_clients", None)
+        if cache is None:
+            from collections import OrderedDict
+
+            cache = self._worker_clients = OrderedDict()
+        c = cache.get(addr)
+        if c is None:
+            c = cache[addr] = RpcClient(addr[0], addr[1],
+                                        self._loop_handle())
+        cache.move_to_end(addr)
+        if len(cache) > self._WORKER_CLIENT_CACHE_MAX:
+            # evict oldest IDLE clients only: closing a client with an
+            # in-flight CreateActor/KillActor would fail that call
+            # spuriously (multi-node gates can exceed the cap in
+            # concurrent pipelines — the cache then temporarily runs
+            # over and shrinks once those calls complete)
+            for old_addr in list(cache):
+                if len(cache) <= self._WORKER_CLIENT_CACHE_MAX:
+                    break
+                old = cache[old_addr]
+                if old is c or old._pending:
+                    continue
+                del cache[old_addr]
+                try:
+                    old.close()
+                except Exception:  # noqa: BLE001
+                    pass
         return c
 
     # ------------------------------------------------------------------
@@ -873,7 +931,7 @@ class GcsServer:
         candidates.sort()
         return candidates[0][1]
 
-    def _creation_gate(self):
+    def _creation_gate(self, node_id: str):
         """Admission control for actor creation (reference:
         GcsActorScheduler bounds in-flight leases per node). A burst of
         thousands of RegisterActor calls must NOT run thousands of
@@ -882,11 +940,44 @@ class GcsServer:
         pipeline times out against the others and creation collapses
         (observed: 624/2000 actors never ALIVE on the 1-CPU CI box).
         Bounded concurrency turns the herd into a steady pipeline at
-        identical throughput — the stages are CPU-bound anyway."""
-        if self._actor_create_gate is None:
-            self._actor_create_gate = asyncio.Semaphore(
+        identical throughput — the stages are CPU-bound anyway.
+
+        One gate PER TARGET NODE (`actor_creation_concurrency` each):
+        total in-flight creations scale with the cluster, and one slow
+        node's pipeline backlog cannot stall placements elsewhere."""
+        gate = self._actor_create_gates.get(node_id)
+        if gate is None:
+            gate = self._actor_create_gates[node_id] = asyncio.Semaphore(
                 max(1, config.actor_creation_concurrency))
-        return self._actor_create_gate
+        return gate
+
+    def _maybe_prestart_workers(self) -> None:
+        """Overlap worker bring-up with the creation pipeline: when a
+        burst of PENDING actors is queued, tell each node's raylet to
+        prefork workers NOW (zygote spawns run while earlier creations
+        hold the gate), so the lease stage of later pipelines finds
+        registered idle workers instead of paying a cold spawn inside
+        its gate slot (reference: WorkerPool::PrestartWorkers,
+        worker_pool.h:280). Throttled; oneway — never blocks scheduling."""
+        now = time.monotonic()
+        if now - self._last_prestart < 0.25:
+            return
+        pending = sum(1 for a in self.actors.values()
+                      if a.state == "PENDING")
+        if pending < 2:
+            return
+        self._last_prestart = now
+        alive = [n for n in self.nodes.values() if n.alive]
+        if not alive:
+            return
+        per_node = max(1, min(config.actor_creation_concurrency,
+                              (pending + len(alive) - 1) // len(alive)))
+        for n in alive:
+            try:
+                self._raylet(n.node_id).call_oneway(
+                    "PrestartWorkers", count=per_node)
+            except Exception:  # noqa: BLE001 — advisory
+                pass
 
     async def _schedule_actor(self, actor: ActorInfo) -> None:
         """Lease a worker for the actor and push its creation task
@@ -921,8 +1012,9 @@ class GcsServer:
             if node_id is None:
                 await asyncio.sleep(0.2)
                 continue
+            self._maybe_prestart_workers()
             gate_wait_from = time.monotonic()
-            async with self._creation_gate():
+            async with self._creation_gate(node_id):
                 # The schedule deadline must budget CREATION time, not
                 # time spent QUEUED behind other creations at the gate:
                 # in a large burst with slow __init__, tail actors sit at
@@ -981,7 +1073,7 @@ class GcsServer:
             return 0.2
         worker_addr = tuple(reply["worker_addr"])
         try:
-            worker = RpcClient(worker_addr[0], worker_addr[1])
+            worker = self._worker_client(worker_addr)
             creation_reply = await worker.acall(
                 "CreateActor",
                 actor_id=actor.actor_id,
@@ -991,7 +1083,6 @@ class GcsServer:
                 # + re-lease in a loop, never letting init finish
                 timeout=config.actor_creation_timeout_s,
             )
-            worker.close()
         except Exception as e:  # noqa: BLE001
             logger.warning("actor %s creation push failed: %s", actor.actor_id[:12], e)
             # the worker may still be running __init__ — return the lease
@@ -1034,7 +1125,12 @@ class GcsServer:
         a = self.actors.get(actor_id)
         self._publish_and_wake(
             "actor_state", actor_id,
-            {"state": a.state, "version": a.version} if a else None,
+            # the event carries enough to RESOLVE the actor (state +
+            # address): subscribers' warm path needs no GetActorInfo
+            # round-trip after the wake
+            {"state": a.state, "version": a.version,
+             "worker_addr": tuple(a.worker_addr) if a.worker_addr else None,
+             "death_cause": a.death_cause} if a else None,
         )
         if a is not None:
             self._log_actor_state(a)  # every state change is durable;
@@ -1160,9 +1256,8 @@ class GcsServer:
             self._notify_actor(actor.actor_id)
         if worker_addr:
             try:
-                worker = RpcClient(worker_addr[0], worker_addr[1])
+                worker = self._worker_client(tuple(worker_addr))
                 await worker.acall("KillActor", actor_id=actor.actor_id, timeout=5)
-                worker.close()
             except Exception:
                 pass
         if not no_restart:

@@ -17,6 +17,8 @@ from __future__ import annotations
 import os
 import struct
 
+from ray_tpu._private import fastpath
+
 
 class BaseID:
     SIZE = 16
@@ -133,8 +135,9 @@ class ObjectID(BaseID):
 
     @classmethod
     def from_index(cls, task_id: TaskID, index: int) -> "ObjectID":
-        """Deterministic return/put object id (reference: ObjectID::FromIndex)."""
-        return cls(task_id.binary() + struct.pack("<I", index))
+        """Deterministic return/put object id (reference: ObjectID::FromIndex).
+        Derived on every submit/return — runs on the fastpath codec."""
+        return cls(fastpath.id_from_index(task_id.binary(), index))
 
     def task_id(self) -> TaskID:
         return TaskID(self._bytes[: TaskID.SIZE])

@@ -152,6 +152,35 @@ def main(small: bool = False) -> List[Dict]:
                           duration_s=dur))
     tch.close()
 
+    # -- actor bring-up (lease + zygote spawn + CreateActor + resolve) --
+    # Burst-create a fleet and wait for every first ping — the
+    # scale_bench many_actors shape, miniaturized; the per-node creation
+    # gate + PrestartWorkers spawn overlap is what this row measures.
+    # Kills and worker teardown happen OUTSIDE the timed window (the
+    # envelope metric is creation, not churn), and the row runs LAST so
+    # its worker churn cannot pollute the other measurements. Best of
+    # three windows: bring-up shares the host's one core with the whole
+    # control plane, so individual windows swing with scheduler luck.
+    n_create = 10
+
+    @ray_tpu.remote(num_cpus=0)
+    class Spawned:
+        def ping(self):
+            return 1
+
+    best = 0.0
+    for _ in range(2 if small else 3):
+        t0 = time.perf_counter()
+        fleet = [Spawned.remote() for _ in range(n_create)]
+        ray_tpu.get([x.ping.remote() for x in fleet])
+        best = max(best, n_create / (time.perf_counter() - t0))
+        for x in fleet:
+            ray_tpu.kill(x)
+        time.sleep(1.0)  # let the killed fleet's workers exit
+    name = f"actor create+first-ping ({n_create})"
+    print(f"{name:<44s} {best:>12,.1f} ops/s")
+    results.append({"name": name, "ops_per_s": best})
+
     ray_tpu.kill(a)
     ray_tpu.kill(b)
     print(json.dumps({r["name"]: round(r["ops_per_s"], 1)

@@ -34,7 +34,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private.config import config
 from ray_tpu._private.ids import NodeID
-from ray_tpu._private.rpc import RpcClient, RpcServer
+from ray_tpu._private.rpc import LoopHandle, RpcClient, RpcServer
 
 logger = logging.getLogger("ray_tpu.raylet")
 
@@ -267,6 +267,14 @@ class Raylet:
         self.prepared_bundles: Dict[Tuple[str, int], Dict[str, Any]] = {}
         self.committed_bundles: Dict[Tuple[str, int], "ResourceSet"] = {}
         self._starting_workers = 0
+        # worker-pool replenishment: peak concurrent leases over the
+        # recent window; after churn (actor kills, OOM reaps) the reap
+        # loop respawns idle workers back toward this level so the next
+        # burst's leases find warm registered workers instead of paying
+        # zygote spawns inside the lease path (reference: WorkerPool
+        # prestart-on-demand). Decays to 0 after 30s without a grant.
+        self._recent_lease_peak = 0
+        self._recent_lease_ts = 0.0
         self._zygote: Optional[Zygote] = None
         self._zygote_lock = threading.Lock()
         self.num_oom_kills = 0
@@ -368,6 +376,48 @@ class Raylet:
         handle = WorkerHandle(worker_id=worker_id, proc=proc)
         self.workers[worker_id] = handle
         return handle
+
+    async def PrestartWorkers(self, count: int = 1) -> dict:
+        """Ensure up to ``count`` spare workers are idle or starting
+        (reference: WorkerPool::PrestartWorkers). The GCS fires this
+        when a burst of PENDING actors queues at its creation gates, and
+        the reap loop fires it to replenish after churn — zygote spawns
+        then overlap the gated lease+CreateActor pipelines instead of
+        running inside them; each spawned worker parks in the idle pool
+        on registration and the next lease request grants instantly."""
+        supply = len(self.idle_workers) + self._starting_workers
+        room = (config.max_workers_per_node - len(self.workers)
+                - self._starting_workers)
+        spawn = min(max(0, int(count)) - supply, room)
+        started = 0
+        loop = asyncio.get_event_loop()
+        for _ in range(max(0, spawn)):
+            self._starting_workers += 1
+            started += 1
+
+            async def _boot():
+                try:
+                    handle = await loop.run_in_executor(
+                        None, self._spawn_worker)
+                    try:
+                        await asyncio.wait_for(
+                            handle.registered.wait(),
+                            timeout=config.worker_startup_timeout_s)
+                    except asyncio.TimeoutError:
+                        handle.dead = True
+                        handle.proc.kill()
+                        self.workers.pop(handle.worker_id, None)
+                        return
+                    handle.idle_since = time.monotonic()
+                    self.idle_workers.append(handle)
+                    self._kick_drain()
+                except Exception:  # noqa: BLE001 — prestart is advisory
+                    logger.exception("prestart spawn failed")
+                finally:
+                    self._starting_workers -= 1
+
+            asyncio.ensure_future(_boot())
+        return {"started": started}
 
     async def RegisterWorker(self, worker_id: str, addr: Tuple[str, int]) -> dict:
         handle = self.workers.get(worker_id)
@@ -641,10 +691,18 @@ class Raylet:
         )
         worker.busy_lease = lease_id
         self.leases[lease_id] = lease
+        now = time.monotonic()
+        if len(self.leases) >= self._recent_lease_peak:
+            self._recent_lease_peak = len(self.leases)
+        self._recent_lease_ts = now
         logger.debug("granting lease %s to worker %s (avail now %s)", lease_id[:8], worker.worker_id[:8], rs.available)
-        # configure the leased worker's visible TPU chips
+        # configure the leased worker's visible TPU chips. The client
+        # binds to THIS loop (LoopHandle): the SetLeaseContext roundtrip
+        # runs in-line on the raylet's own event loop instead of hopping
+        # threads to the global client loop and back.
         try:
-            wclient = RpcClient(worker.addr[0], worker.addr[1])
+            wclient = RpcClient(worker.addr[0], worker.addr[1],
+                                self._loop_handle())
             await wclient.acall(
                 "SetLeaseContext",
                 lease_id=lease_id,
@@ -1165,9 +1223,14 @@ class Raylet:
 
     async def _reap_loop(self) -> None:
         """Detect dead worker processes; free leases; tell GCS (for actor
-        fail-over) — reference: raylet owns worker procs and reports deaths."""
+        fail-over) — reference: raylet owns worker procs and reports deaths.
+
+        The sweep is O(workers) of pidfd polls held on the loop; its
+        period scales with the pool so a 2,000-worker node spends the
+        same loop share on reaping as a 10-worker one (death-notice
+        latency degrades gracefully instead of the event loop)."""
         while True:
-            await asyncio.sleep(0.5)
+            await asyncio.sleep(min(4.0, 0.5 + 0.002 * len(self.workers)))
             for w in list(self.workers.values()):
                 if w.proc.poll() is not None and not w.dead:
                     logger.warning("worker %s exited with %s", w.worker_id[:8], w.proc.returncode)
@@ -1196,7 +1259,23 @@ class Raylet:
                 # background task with a short timeout: a hung GCS must
                 # not stall the reap loop's death detection
                 asyncio.ensure_future(self._flush_death_notices())
+            await self._replenish_workers()
             self._kick_drain()
+
+    async def _replenish_workers(self) -> None:
+        """Respawn idle workers toward the recent lease-demand peak after
+        churn. Bounded by the creation-gate budget, and the peak decays
+        30s after the last grant, so a finished burst's spares idle out
+        through the normal reaper instead of flapping."""
+        now = time.monotonic()
+        if now - self._recent_lease_ts > 30.0:
+            self._recent_lease_peak = 0
+            return
+        target = (min(self._recent_lease_peak,
+                      config.actor_creation_concurrency)
+                  - len(self.leases))
+        if target > 0:
+            await self.PrestartWorkers(count=target)
 
     async def _flush_death_notices(self) -> None:
         self._death_flush_running = True
@@ -1354,6 +1433,13 @@ class Raylet:
             timeout=30,
         )
 
+    def _loop_handle(self) -> LoopHandle:
+        h = getattr(self, "_loop_handle_cached", None)
+        if h is None:
+            h = self._loop_handle_cached = LoopHandle(
+                asyncio.get_event_loop())
+        return h
+
     async def run(self) -> None:
         # start the native object store daemon for this node (no-evict:
         # the spill path below preserves data instead of LRU-dropping it)
@@ -1364,7 +1450,10 @@ class Raylet:
             self.store_socket, self.store_capacity, no_evict=True
         )
         self.store = StoreClient(self.store_socket)
-        self.gcs = RpcClient(self.gcs_addr[0], self.gcs_addr[1])
+        # gcs client rides this raylet's OWN event loop (LoopHandle): no
+        # cross-thread handoff per heartbeat/lease-path RPC
+        self.gcs = RpcClient(self.gcs_addr[0], self.gcs_addr[1],
+                             self._loop_handle())
 
         server_task = asyncio.ensure_future(self.server.serve_forever())
         # wait until the port is bound

@@ -38,6 +38,7 @@ import threading
 import time
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
+from ray_tpu._private import fastpath
 from ray_tpu._private.config import config
 
 logger = logging.getLogger(__name__)
@@ -49,7 +50,6 @@ KIND_ONEWAY = 2
 KIND_OOB_FLAG = 0x80
 KIND_MASK = 0x7F
 
-_HDR = struct.Struct("<IQB")
 _U32 = struct.Struct("<I")
 _U64 = struct.Struct("<Q")
 
@@ -113,9 +113,7 @@ def _chaos_action(method: str) -> Optional[str]:
 
 async def _read_frame(reader: asyncio.StreamReader) -> Tuple[int, int, bytes]:
     header = await reader.readexactly(13)
-    (length,) = struct.unpack_from("<I", header, 0)
-    (call_id,) = struct.unpack_from("<Q", header, 4)
-    kind = header[12]
+    length, call_id, kind = fastpath.unpack_header(header)
     body = await reader.readexactly(length)
     return call_id, kind, body
 
@@ -146,36 +144,33 @@ def _encode_body(obj: Any) -> Tuple[int, list, int]:
     meta = pickle.dumps(obj, protocol=5, buffer_callback=_cb)
     if not bufs:
         return 0, [meta], len(meta)
-    segs: list = [_U32.pack(len(meta)), meta, _U32.pack(len(bufs))]
+    raws: list = []
     total = 8 + len(meta)
     for b in bufs:
         raw = b.raw()
         if raw.ndim != 1 or raw.format != "B":
             raw = raw.cast("B")
+        raws.append(raw)
+        total += 8 + raw.nbytes
+    if total <= _GATHER_CUTOFF:
+        # small OOB body: the coalescing sink would copy each borrowed
+        # segment to owned bytes anyway — one codec pass builds the
+        # whole owned body instead (fastpath.encode_body, native when
+        # the extension is loaded)
+        return KIND_OOB_FLAG, [fastpath.encode_body(meta, raws)], total
+    segs: list = [_U32.pack(len(meta)), meta, _U32.pack(len(raws))]
+    for raw in raws:
         segs.append(_U64.pack(raw.nbytes))
         segs.append(raw)
-        total += 8 + raw.nbytes
     return KIND_OOB_FLAG, segs, total
 
 
 def _decode_body(kind: int, body: bytes) -> Any:
     """Inverse of _encode_body; out-of-band buffers are zero-copy views
-    into the received body."""
+    into the received body (fastpath codec: one native parse pass)."""
     if not kind & KIND_OOB_FLAG:
         return pickle.loads(body)
-    mv = memoryview(body)
-    (meta_len,) = _U32.unpack_from(mv, 0)
-    off = 4
-    meta = mv[off: off + meta_len]
-    off += meta_len
-    (nbuf,) = _U32.unpack_from(mv, off)
-    off += 4
-    buffers = []
-    for _ in range(nbuf):
-        (blen,) = _U64.unpack_from(mv, off)
-        off += 8
-        buffers.append(mv[off: off + blen])
-        off += blen
+    meta, buffers = fastpath.decode_body(body)
     return pickle.loads(meta, buffers=buffers)
 
 
@@ -209,11 +204,23 @@ class _FrameSink:
         self._tick_armed = False
 
     def write_frame(self, call_id: int, kind: int, segs: list, total: int) -> None:
-        header = _HDR.pack(total, call_id, kind)
         first = not self._tick_armed
         if first:
             self._tick_armed = True
             asyncio.get_event_loop().call_soon(self._end_tick)
+        if total <= _SMALL_FRAME_MAX and len(segs) == 1:
+            # single-segment small frame (plain pickle body, or an OOB
+            # body already joined by the codec): header + body assemble
+            # in ONE fastpath allocation — owned bytes, so it is safe
+            # both to coalesce and to hand to the transport directly
+            frame = fastpath.build_frame(call_id, kind, segs[0])
+            if first:
+                self._flush_small()
+                self.writer.write(frame)
+            else:
+                self._small.append(frame)
+            return
+        header = fastpath.pack_header(total, call_id, kind)
         if total <= _SMALL_FRAME_MAX and not first:
             # follower in this tick: coalesce. Segments must be owned
             # bytes, not borrowed views (caller may mutate after return).
@@ -309,6 +316,30 @@ class EventLoopThread:
         self.loop.call_soon_threadsafe(self.loop.stop)
 
 
+class LoopHandle:
+    """EventLoopThread-shaped handle for a loop the CALLING process
+    already runs on its main thread (the gcs/raylet asyncio daemons).
+
+    An RpcClient bound to this handle does its connection I/O on the
+    daemon's own loop, so ``acall`` from a handler coroutine runs
+    in-line — the default global EventLoopThread would put every
+    outbound control RPC through two cross-thread handoffs (submit +
+    wakeup), which on a 1-core host is a large slice of lease-grant and
+    actor-creation latency."""
+
+    def __init__(self, loop: asyncio.AbstractEventLoop):
+        self.loop = loop
+
+    def run_coro(self, coro: Awaitable, timeout: Optional[float] = None) -> Any:
+        """Foreign-thread entry (sync .call paths); never call from the
+        owning loop itself — that would deadlock the loop on its result."""
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def call_soon(self, cb: Callable, *args) -> None:
+        self.loop.call_soon_threadsafe(cb, *args)
+
+
 class RpcServer:
     """Serve registered handlers. Handlers may be sync or async; they run on
     the server's event loop (async) or a thread pool (sync)."""
@@ -331,12 +362,20 @@ class RpcServer:
         # methods that legitimately park for their whole timeout (pubsub
         # long-polls): exempt from the slow-async-handler warning
         self._long_poll: set = set()
+        # sync handlers cheap enough to run ON the loop (queue append,
+        # memory-store put, dict bookkeeping): skipping the executor
+        # handoff saves two thread hops per call — on a 1-core host that
+        # is a large slice of small-RPC latency. Inline time counts as
+        # loop-held, so the slow-handler warning polices the choice.
+        self._inline: set = set()
 
     def register(self, method: str, handler: Callable,
-                 long_poll: bool = False) -> None:
+                 long_poll: bool = False, inline: bool = False) -> None:
         self._handlers[method] = handler
         if long_poll:
             self._long_poll.add(method)
+        if inline:
+            self._inline.add(method)
 
     def register_instance(self, obj: Any, prefix: str = "") -> None:
         """Register every public method of ``obj`` as a handler."""
@@ -424,6 +463,12 @@ class RpcServer:
             is_async = asyncio.iscoroutinefunction(handler)
             if is_async:
                 result = await handler(**kwargs)
+            elif method in self._inline:
+                # registered inline: cheap bookkeeping handler runs on
+                # the loop directly; its time is loop-held by definition
+                ti = time.monotonic()
+                result = handler(**kwargs)
+                loop_held += time.monotonic() - ti
             else:
                 # sync handlers never run on the loop: the blocking part
                 # of actor bootstrap (ctor-arg unpickling, zygote
@@ -449,7 +494,13 @@ class RpcServer:
             flags, segs, total = _encode_body(
                 (False, f"{type(e).__name__}: {e}\n{traceback.format_exc()}"))
         dt = time.monotonic() - t0
-        if loop_held * 1000 > config.event_loop_slow_handler_ms:
+        # an inline handler's wall time inflates under process-wide GIL
+        # saturation (every thread is equally stalled) — warn only well
+        # past the threshold so a busy-but-healthy worker doesn't spam
+        # slow-handler lines for queue appends
+        held_budget_ms = config.event_loop_slow_handler_ms * (
+            5 if method in self._inline else 1)
+        if loop_held * 1000 > held_budget_ms:
             # decode/encode/framing time — genuinely holds the loop for
             # sync AND async handlers alike
             logger.warning(
@@ -643,6 +694,18 @@ class RpcClient:
             self._pending.clear()
 
         try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is self._loop_thread.loop:
+            # caller IS the owning loop (a LoopHandle-bound client closed
+            # from a gcs/raylet handler): blocking run_coro would deadlock
+            # the loop on itself — detach the teardown instead
+            task = asyncio.ensure_future(_close())
+            _oneway_tasks.add(task)
+            task.add_done_callback(_oneway_done)
+            return
+        try:
             self._loop_thread.run_coro(_close(), timeout=5)
         except Exception:
             pass
@@ -663,7 +726,14 @@ def get_client(addr: Tuple[str, int]) -> RpcClient:
 
 
 def clear_client_cache() -> None:
+    # Snapshot-then-close: closing INSIDE the lock livelocked shutdown —
+    # each close() parks 5s in run_coro while the io loop sits blocked in
+    # get_client() on this same lock (observed: a 2,000-actor driver's
+    # teardown wedged for hours, 5s per cached client). With the lock
+    # dropped first, the loop's get_client proceeds and every close's
+    # coroutine actually runs.
     with _client_cache_lock:
-        for c in _client_cache.values():
-            c.close()
+        clients = list(_client_cache.values())
         _client_cache.clear()
+    for c in clients:
+        c.close()

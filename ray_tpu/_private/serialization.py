@@ -32,6 +32,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import cloudpickle
 
+from ray_tpu._private import fastpath
+
 _custom_serializers: Dict[type, Tuple[Callable, Callable]] = {}
 _lock = threading.Lock()
 
@@ -217,21 +219,11 @@ class SerializedValue:
 
     def write_into(self, dest: memoryview) -> int:
         """Single-pass copy-free layout into ``dest`` (length >= .total):
-        payload bytes move exactly once, source buffer → dest. Returns the
-        number of bytes written."""
-        off = 0
-        struct.pack_into("<I", dest, off, len(self.meta))
-        off += 4
-        dest[off: off + len(self.meta)] = self.meta
-        off += len(self.meta)
-        struct.pack_into("<I", dest, off, len(self.buffers))
-        off += 4
-        for b in self.buffers:
-            struct.pack_into("<Q", dest, off, b.nbytes)
-            off += 8
-            dest[off: off + b.nbytes] = b
-            off += b.nbytes
-        return off
+        payload bytes move exactly once, source buffer → dest. Runs on the
+        fastpath codec — the C backend releases the GIL around the payload
+        memcpy, so a multi-MB put no longer stalls sibling threads.
+        Returns the number of bytes written."""
+        return fastpath.write_body_into(dest, self.meta, self.buffers)
 
     def to_bytes(self, copy_path: Optional[str] = "put") -> bytes:
         """Materialize the frame as one bytes object (the legacy join) —
@@ -343,20 +335,11 @@ def deserialize(data: "bytes | memoryview", release_cb: Optional[Callable] = Non
     value viewing them has been collected (pin-for-value-lifetime)."""
     shared = [0, release_cb, threading.Lock()]
     try:
-        mv = memoryview(data)
-        (meta_len,) = struct.unpack_from("<I", mv, 0)
-        off = 4
-        meta = mv[off : off + meta_len]
-        off += meta_len
-        (nbuf,) = struct.unpack_from("<I", mv, off)
-        off += 4
+        meta, raw_buffers = fastpath.decode_body(data)
         buffers = []
-        for _ in range(nbuf):
-            (blen,) = struct.unpack_from("<Q", mv, off)
-            off += 8
-            sl = mv[off : off + blen]  # zero-copy view
+        for sl in raw_buffers:
             if release_cb is None:
-                buffers.append(sl)
+                buffers.append(sl)  # zero-copy view
             elif _HAS_PEP688:
                 buffers.append(_TrackedBuffer(sl, shared))
             else:
@@ -364,9 +347,8 @@ def deserialize(data: "bytes | memoryview", release_cb: Optional[Callable] = Non
                 # tracked zero-copy wrapper is invisible to consumers
                 # (np.frombuffer raises). Copy the slice; the pin then
                 # releases in the finally below instead of at value GC.
-                record_payload_copy("get", blen)
+                record_payload_copy("get", sl.nbytes)
                 buffers.append(bytes(sl))
-            off += blen
         return pickle.loads(
             bytes(meta) if isinstance(meta, memoryview) else meta, buffers=buffers
         )

@@ -110,9 +110,17 @@ class TaskSpec:
         """Group tasks by (fn, resources, runtime env) for lease reuse
         (reference: SchedulingClass in src/ray/common/task/task_spec.h —
         the reference's class includes the runtime env so leased workers
-        are never shared across envs)."""
+        are never shared across envs).
+
+        Computed once per spec and cached: the submit path reads it
+        several times per task (submit, lease lookup, queue keying), and
+        its inputs (descriptor, resources, strategy, runtime env) are
+        fixed at construction — only attempt_number mutates later."""
+        cached = self.__dict__.get("_scheduling_class_cache")
+        if cached is not None:
+            return cached
         st = self.scheduling_strategy
-        return (
+        self.__dict__["_scheduling_class_cache"] = cached = (
             self.function_descriptor.key(),
             tuple(sorted(self.resources.items())),
             st.kind,
@@ -123,6 +131,7 @@ class TaskSpec:
             tuple(sorted((st.node_labels or {}).items())),
             self.runtime_env_hash(),
         )
+        return cached
 
     def runtime_env_hash(self) -> str:
         if not self.runtime_env:

@@ -572,9 +572,15 @@ class WorkerServer:
         core.server.register("PushTaskBatch", self.PushTaskBatch)
         core.server.register("CancelTask", self.CancelTask)
         core.server.register("CreateActor", self.CreateActor)
-        core.server.register("PushActorTask", self.PushActorTask)
-        core.server.register("PushActorTasks", self.PushActorTasks)
-        core.server.register("QueryActorTaskResult", self.QueryActorTaskResult)
+        # enqueue-and-ack handlers only append to the runner's pool queue:
+        # inline (no executor handoff) — the ack is on the wire the same
+        # loop tick the push frame decodes
+        core.server.register("PushActorTask", self.PushActorTask,
+                             inline=True)
+        core.server.register("PushActorTasks", self.PushActorTasks,
+                             inline=True)
+        core.server.register("QueryActorTaskResult",
+                             self.QueryActorTaskResult, inline=True)
         core.server.register("KillActor", self.KillActor)
         core.server.register("SetLeaseContext", self.SetLeaseContext)
         core.server.register("Exit", self.Exit)

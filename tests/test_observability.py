@@ -588,7 +588,9 @@ class TestActorCreationGate:
         from ray_tpu._private.gcs.server import ActorInfo, GcsServer
 
         server = GcsServer.__new__(GcsServer)
-        server._actor_create_gate = None
+        server._actor_create_gates = {}
+        server._last_prestart = 0.0
+        server.actors = {}
         server.placement_groups = {}
         server.nodes = {}
         server._pick_node_for = (
