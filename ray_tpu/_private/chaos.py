@@ -3,7 +3,10 @@
 Reference: python/ray/_private/test_utils.py:1355 (`ResourceKillerActor`
 / `NodeKillerBase` used by python/ray/tests/chaos/ and the nightly
 chaos suite). RPC-level injection lives in _private/rpc.py
-(`testing_rpc_failure`, mirroring src/ray/rpc/rpc_chaos.h).
+(``testing_rpc_failure``, mirroring src/ray/rpc/rpc_chaos.h — including
+the ``Method=prob:delay_ms`` latency form; ``rpc_delay_spec`` below
+builds one). ``PreemptionInjector`` models TPU capacity loss: a short
+drain notice with a jittered deadline, then the host vanishes.
 """
 
 from __future__ import annotations
@@ -14,6 +17,12 @@ import time
 from typing import List, Optional
 
 import psutil
+
+
+def rpc_delay_spec(method: str, prob: float, delay_ms: float) -> str:
+    """One ``testing_rpc_failure`` entry injecting latency instead of a
+    failure (join multiple with commas)."""
+    return f"{method}={prob:g}:{delay_ms:g}"
 
 
 def list_worker_pids(raylet_pid: int) -> List[int]:
@@ -117,6 +126,81 @@ class NodeKiller:
 
         self._thread = threading.Thread(target=_loop, daemon=True,
                                         name="chaos-node-killer")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+class PreemptionInjector:
+    """TPU-style preemption notices against a cluster_utils.Cluster:
+    a random NON-HEAD node gets a graceful ``DrainNode`` with reason
+    PREEMPTION and a seeded, jittered deadline; at deadline + grace the
+    host is hard-killed if it hasn't deregistered itself (real
+    preemptions don't wait for a polite exit). Seeded for reproducible
+    chaos runs."""
+
+    def __init__(self, cluster, interval_s: float = 10.0,
+                 max_preemptions: int = 1, seed: int = 0,
+                 deadline_s: float = 10.0, jitter_s: float = 2.0,
+                 kill_grace_s: float = 3.0):
+        self.cluster = cluster
+        self.interval_s = interval_s
+        self.max_preemptions = max_preemptions
+        self.deadline_s = deadline_s
+        self.jitter_s = jitter_s
+        self.kill_grace_s = kill_grace_s
+        self.preempted: List[str] = []
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def preempt_one(self) -> Optional[str]:
+        """Issue one preemption notice; blocks until the node is gone
+        (deadline + grace at most). Returns the node id, or None when
+        only the head node remains."""
+        from ray_tpu._private.drain import REASON_PREEMPTION
+        from ray_tpu._private.node import kill_process_tree
+        from ray_tpu._private.rpc import RpcClient
+
+        candidates = [n for n in self.cluster.nodes if not n.is_head]
+        if not candidates:
+            return None
+        victim = self._rng.choice(candidates)
+        deadline = max(0.5, self.deadline_s + self._rng.uniform(
+            -self.jitter_s, self.jitter_s))
+        client = RpcClient("127.0.0.1", self.cluster.gcs_port)
+        try:
+            client.call("DrainNode", node_id=victim.node_id,
+                        reason=REASON_PREEMPTION, deadline_s=deadline,
+                        timeout=10)
+        except Exception:  # noqa: BLE001 — the hard kill below still fires
+            pass
+        finally:
+            client.close()
+        # the raylet normally deregisters and exits on its own; the
+        # preemption hard-stop at deadline + grace is the contract
+        stop_at = time.monotonic() + deadline + self.kill_grace_s
+        while time.monotonic() < stop_at and not self._stop.is_set():
+            if victim.proc.poll() is not None:
+                break
+            time.sleep(0.1)
+        kill_process_tree(victim.proc, force=True)
+        if victim in self.cluster.nodes:
+            self.cluster.nodes.remove(victim)
+        self.preempted.append(victim.node_id)
+        return victim.node_id
+
+    def start(self) -> None:
+        def _loop():
+            while not self._stop.wait(self.interval_s) and \
+                    len(self.preempted) < self.max_preemptions:
+                self.preempt_one()
+
+        self._thread = threading.Thread(target=_loop, daemon=True,
+                                        name="chaos-preemption")
         self._thread.start()
 
     def stop(self) -> None:

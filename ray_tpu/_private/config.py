@@ -50,6 +50,14 @@ _DEFINITIONS: Dict[str, Tuple[type, Any]] = {
     # budget >=30s for detection, so 20s keeps their margin.
     "gcs_health_check_failure_threshold": (int, 20),
     "gcs_pubsub_poll_timeout_s": (float, 30.0),
+    # --- graceful node drain (reference: DrainNode with a deadline and
+    # DRAIN_NODE_REASON_PREEMPTION in gcs_service.proto; TPU preemption
+    # notices give the whole slice a short window to quiesce) ---
+    "drain_deadline_default_s": (float, 30.0),
+    # how long past its deadline a DRAINING node may sit before the GCS
+    # watchdog force-completes the drain (marks it dead) — bounds the
+    # "node stuck DRAINING forever" failure mode across GCS restarts
+    "drain_watchdog_grace_s": (float, 5.0),
     # --- raylet / scheduler ---
     "raylet_heartbeat_period_ms": (int, 500),
     "worker_lease_timeout_ms": (int, 30000),

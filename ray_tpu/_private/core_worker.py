@@ -92,7 +92,8 @@ class _TransientSchedulingError(Exception):
 
 
 class _LeaseEntry:
-    __slots__ = ("lease_id", "worker_addr", "busy", "last_used", "raylet_addr")
+    __slots__ = ("lease_id", "worker_addr", "busy", "last_used",
+                 "raylet_addr", "warm")
 
     def __init__(
         self,
@@ -107,6 +108,13 @@ class _LeaseEntry:
         self.raylet_addr = raylet_addr
         self.busy = False
         self.last_used = time.monotonic()
+        # the lease already completed at least one push: the worker was
+        # healthy AFTER grant. A ConnectionError on a warm lease means
+        # the keepalive cache outlived its worker (SIGKILL, node drain)
+        # — that is a lease-layer fault, retried for FREE rather than
+        # burning the task's max_retries (reference: lease-level retries
+        # in normal_task_submitter never charge the app retry budget)
+        self.warm = False
 
 
 class _ActorDispatcher:
@@ -292,12 +300,27 @@ class _ActorDispatcher:
             except (RpcConnectionError, ConnectionError, OSError,
                     TimeoutError) as e:
                 self._unregister(batch)
-                # The push may or may not have reached the worker before the
-                # connection broke, so resending could execute it twice.
-                # Actor tasks are at-most-once (reference: actor tasks are
-                # not retried unless max_task_retries > 0) — report the
-                # fault (triggers restart per max_restarts) and fail THIS
-                # batch; queued successors will reach the new incarnation.
+                # Planned loss first: if the GCS already moved this actor
+                # off the address we pushed to (node drain migrates
+                # actors BEFORE their workers die), the dead worker had
+                # stopped accepting — the batch was never enqueued there,
+                # so resending to the new incarnation keeps at-most-once.
+                if await self._moved_by_drain(addr):
+                    self.core._invalidate_actor_addr(self.aid, addr)
+                    if time.monotonic() > deadline:
+                        _fail_all(RayActorError(
+                            f"Actor {self.aid[:12]} not reachable at a "
+                            f"stable address"))
+                        return
+                    await asyncio.sleep(0.2)
+                    continue
+                # Unplanned: the push may or may not have reached the
+                # worker before the connection broke, so resending could
+                # execute it twice. Actor tasks are at-most-once
+                # (reference: actor tasks are not retried unless
+                # max_task_retries > 0) — report the fault (triggers
+                # restart per max_restarts) and fail THIS batch; queued
+                # successors will reach the new incarnation.
                 await self.core._report_actor_fault_async(
                     self.aid, addr, str(e))
                 _fail_all(RayActorError(
@@ -322,6 +345,29 @@ class _ActorDispatcher:
             for payload, _ in batch:
                 self.core._pending_actor_tasks.pop(
                     TaskID(payload["task_id"]), None)
+
+    async def _moved_by_drain(self, pushed_addr: Tuple[str, int]) -> bool:
+        """True when the GCS has already restarted this actor away from
+        ``pushed_addr`` BECAUSE ITS NODE DRAINED — i.e. the address we
+        pushed to was a planned casualty. Requires the drain cause, not
+        just a state change: a crash can also reach the GCS (raylet
+        death report) before we process our own ConnectionError, and
+        resending after a crash could double-execute an at-most-once
+        actor task. Drain is safe: the old instance stopped ACCEPTING
+        before the restart was published, so a connection-failed push
+        was never enqueued there."""
+        try:
+            info = await self.core.gcs.acall(
+                "GetActorInfo", actor_id=self.aid, timeout=10)
+        except Exception:  # noqa: BLE001
+            return False
+        if not info or "draining" not in (info.get("death_cause") or ""):
+            return False
+        if info.get("state") == "RESTARTING":
+            return True
+        cur = tuple(info["worker_addr"]) if info.get("worker_addr") else None
+        return info.get("state") == "ALIVE" and cur is not None \
+            and cur != tuple(pushed_addr)
 
     # -- watcher (io loop): pushed actor state + lost-result recovery ---
     async def _watch(self) -> None:
@@ -373,15 +419,22 @@ class _ActorDispatcher:
                     current = cached[0] if cached else None
                 now = time.monotonic()
                 for t, i in mine.items():
-                    # enqueued on an incarnation that is gone → lost
-                    if changed and i["addr"] != current:
-                        self.core._fail_actor_task(
-                            t, i["return_oids"],
-                            RayActorError(
-                                f"Actor {self.aid[:12]} restarted; task "
-                                f"{t.hex()[:12]} was lost"))
+                    # enqueued on an incarnation that is gone: before
+                    # declaring it lost, ask the OLD worker — a drained
+                    # node's actor finishes its accepted tasks before the
+                    # restart is published, so the result is usually
+                    # sitting in its cache (or the done push already
+                    # landed); only an unreachable/amnesiac old worker
+                    # fails the task. Re-checked on the periodic sweep
+                    # too: a "running" reply from the old incarnation
+                    # must not park the task forever if that worker then
+                    # dies without another state event.
+                    stale = now - i.get("ts", now) > self._REQUERY_AGE_S
+                    if i["addr"] != current and (changed or stale):
+                        await self._requery(t, i, i["addr"],
+                                            fail_unreachable=True)
                     elif current is not None and i["addr"] == current \
-                            and now - i.get("ts", now) > self._REQUERY_AGE_S:
+                            and stale:
                         # healthy actor, old pending task: the result
                         # push may have been lost — ask the worker
                         await self._requery(t, i, current)
@@ -402,6 +455,7 @@ class _ActorDispatcher:
 
     async def _requery(
         self, tid: TaskID, info: dict, addr: Tuple[str, int],
+        fail_unreachable: bool = False,
     ) -> None:
         try:
             reply = await get_client(addr).acall(
@@ -413,6 +467,14 @@ class _ActorDispatcher:
         except asyncio.CancelledError:
             raise
         except Exception:  # noqa: BLE001
+            if fail_unreachable:
+                # the incarnation this task was enqueued on is gone AND
+                # unreachable — the task is lost for real
+                self.core._fail_actor_task(
+                    tid, info["return_oids"],
+                    RayActorError(
+                        f"Actor {self.aid[:12]} restarted; task "
+                        f"{tid.hex()[:12]} was lost"))
             return  # connection-level failures are the watcher's job
         status = reply.get("status")
         if status == "done":
@@ -499,6 +561,13 @@ class _ActorStateHub:
                     for ev in s:
                         ev.set()
             for _seqno, aid, payload in rep.get("events", ()):
+                if isinstance(payload, dict) and \
+                        payload.get("state") != "ALIVE":
+                    # the cached resolve address is stale the moment the
+                    # actor leaves ALIVE (drain migration, restart): drop
+                    # it so new submits block on the fresh address
+                    # instead of pushing at the doomed incarnation
+                    self.core._actor_addr_cache.pop(aid, None)
                 watchers = self._events.get(aid)
                 if not watchers:
                     continue
@@ -1113,10 +1182,50 @@ class CoreWorker(CoreRuntime):
                 self._node_addrs[n["NodeID"]] = (n["NodeManagerAddress"], n["NodeManagerPort"])
             return self._node_addrs.get(node_id)
 
-    def _pull_remote_object(self, oid: ObjectID, node_id: str, _retry: bool = True) -> None:
+    def _lookup_moved_object(self, oid: ObjectID,
+                             not_node: str) -> Optional[str]:
+        """A drained node pushed its primary copies to a survivor and
+        registered them with the GCS — consult that directory before
+        declaring the object lost."""
+        try:
+            rep = self.gcs.call_retrying(
+                "LookupObjectLocations", object_id_bins=[oid.binary()],
+                timeout=10)
+        except Exception:  # noqa: BLE001
+            return None
+        new_node = (rep or {}).get(oid.binary())
+        return new_node if new_node and new_node != not_node else None
+
+    def _pull_remote_object(self, oid: ObjectID, node_id: str,
+                            _retry: bool = True,
+                            _check_moved: bool = True) -> None:
         """Fetch a plasma object from another node's store into the local
         store, chunked (reference: object_manager.cc:221 Pull + :614
-        ReceiveObjectChunk; ours is reader-driven over the raylet RPC)."""
+        ReceiveObjectChunk; ours is reader-driven over the raylet RPC).
+        When the recorded node is gone (drained/preempted), falls back to
+        the GCS moved-object directory before giving up."""
+        if _check_moved:
+            try:
+                return self._pull_remote_object(
+                    oid, node_id, _retry=_retry, _check_moved=False)
+            except ObjectLostError:
+                new_node = self._lookup_moved_object(oid, node_id)
+                if new_node is None:
+                    raise
+                logger.info(
+                    "object %s moved off drained node %s -> %s",
+                    oid.hex()[:12], node_id[:12], new_node[:12])
+                self._pull_remote_object(
+                    oid, new_node, _retry=_retry, _check_moved=False)
+                if self._ref_counter().is_owned(oid):
+                    # later reads go straight to the new primary. OWNED
+                    # entries only: writing a location entry into a
+                    # BORROWER's store would shadow its owner-mediated
+                    # path (_get_one asks the owner, who can reconstruct
+                    # from lineage) with a dead end once this copy and
+                    # the directory entry are gone
+                    self.memory_store.put(oid, ("plasma", new_node))
+                return
         addr = self._node_raylet_addr(node_id)
         if addr is None:
             raise ObjectLostError(
@@ -1632,7 +1741,10 @@ class CoreWorker(CoreRuntime):
             if cached:
                 return cached[1]
             raise _TransientSchedulingError(str(e)) from None
-        alive = [n for n in infos if n.get("Alive")]
+        # DRAINING nodes are alive but must not receive new placements —
+        # schedulers route around them the moment the drain is published
+        alive = [n for n in infos
+                 if n.get("Alive") and not n.get("Draining")]
         self._node_view_cache = (now, alive)
         return alive
 
@@ -1982,9 +2094,12 @@ class CoreWorker(CoreRuntime):
                     timeout=-1,  # tasks can run arbitrarily long
                 )]
             else:
-                replies = (await client.acall(
-                    "PushTaskBatch", spec_payloads=payloads,
-                    timeout=-1))["replies"]
+                batch_reply = await client.acall(
+                    "PushTaskBatch", spec_payloads=payloads, timeout=-1)
+                if batch_reply.get("node_draining"):
+                    await self._handle_lease_recalled(live, entry)
+                    return
+                replies = batch_reply["replies"]
         except RemoteError as e:
             # worker is alive but the push itself failed (e.g. payload
             # could not be decoded) — a task error, NOT a worker death
@@ -2007,9 +2122,30 @@ class CoreWorker(CoreRuntime):
             return
         except Exception as e:  # noqa: BLE001
             logger.warning("push of %d task(s) failed: %s", len(live), e)
-            await self._handle_worker_failure(live, entry, e)
+            await self._handle_worker_failure(
+                live, entry, e,
+                lease_was_warm=entry.warm and isinstance(
+                    e, (RpcConnectionError, ConnectionError, OSError)))
             return
         batched = len(payloads) > 1
+        recalled = [spec for spec, reply in zip(live, replies)
+                    if reply.get("node_draining")]
+        if recalled:
+            # the worker refused mid-stream: its node started draining.
+            # Complete what did run, then re-lease the rest elsewhere.
+            done_pairs = [(s, r) for s, r in zip(live, replies)
+                          if not r.get("node_draining")]
+            for spec, reply in done_pairs:
+                if reply.get("need_function"):
+                    recalled.append(spec)  # resubmit ships the bytes
+                    continue
+                if spec.function_key:
+                    shipped.add(spec.function_key)
+                if not batched or self._claim_push_completion(
+                        spec.task_id, spec.attempt_number):
+                    self._complete_task(spec, reply)
+            await self._handle_lease_recalled(recalled, entry)
+            return
         retry_with_bytes: List[TaskSpec] = []
         for spec, reply in zip(live, replies):
             if reply.get("need_function"):
@@ -2046,6 +2182,7 @@ class CoreWorker(CoreRuntime):
             self._complete_task(spec, reply)
         entry.busy = False
         entry.last_used = time.monotonic()
+        entry.warm = True  # survived a full push: see _LeaseEntry.warm
         await self._on_lease_idle(sc, entry)
 
     def _driver_py_paths(self) -> List[str]:
@@ -2145,9 +2282,46 @@ class CoreWorker(CoreRuntime):
         self._complete_task(spec, reply)
         return {"ok": True}
 
+    async def _handle_lease_recalled(self, specs: List[TaskSpec],
+                                     entry: _LeaseEntry) -> None:
+        """The leased worker's node is draining and refused the push
+        (nothing executed): return the lease to its raylet and re-lease
+        the tasks elsewhere — a recall is the lease layer's problem, so
+        it never charges the tasks' max_retries."""
+        sc = specs[0].scheduling_class
+        with self._lock:
+            entries = self._leases.get(sc, [])
+            if entry in entries:
+                entries.remove(entry)
+        try:
+            await self._lease_raylet(entry).acall(
+                "ReturnWorkerLease", lease_id=entry.lease_id)
+        except Exception:  # noqa: BLE001 — the raylet may already be gone
+            pass
+        logger.info("lease %s recalled (node draining); re-leasing %d "
+                    "task(s)", entry.lease_id[:8], len(specs))
+        for spec in specs:
+            st = self._pending_tasks.get(spec.task_id)
+            if st is None or st.get("cancelled"):
+                continue
+            spec.attempt_number += 1
+            await self._submit_spec(spec)
+
+    # a task gets this many FREE re-leases after warm-lease connection
+    # failures before the failure starts charging max_retries — bounds a
+    # pathological churn loop without ever failing a task merely because
+    # the keepalive cache handed it a dead worker. Known tradeoff: the
+    # caller cannot tell "worker died between pushes" (pure cache fault)
+    # from "worker died mid-push" — a max_retries=0 task whose worker is
+    # killed WHILE executing gets re-run once here. The reference makes
+    # the same call at its lease layer; tasks needing strict
+    # at-most-once must be idempotent or use actors.
+    _WARM_FREE_RETRIES = 3
+
     async def _handle_worker_failure(self, specs: List[TaskSpec],
                                      entry: _LeaseEntry,
-                                     error: Exception) -> None:
+                                     error: Exception,
+                                     lease_was_warm: bool = False) -> None:
         sc = specs[0].scheduling_class
         with self._lock:
             entries = self._leases.get(sc, [])
@@ -2169,10 +2343,24 @@ class CoreWorker(CoreRuntime):
                 # the connection) died — failing it now would overwrite
                 # a delivered result with WorkerCrashedError
                 continue
-            if st is not None and st["retries_left"] > 0 and not st.get("cancelled"):
-                st["retries_left"] -= 1
+            free = False
+            if lease_was_warm and st is not None and not st.get("cancelled"):
+                # a warm (keepalive-cached) lease whose worker vanished
+                # (SIGKILL between calls, node drained): the failure is
+                # the CACHE's, not the task's — re-lease elsewhere
+                # without touching retries_left, even at max_retries=0
+                warm_used = getattr(spec, "_warm_free_retries", 0)
+                if warm_used < self._WARM_FREE_RETRIES:
+                    spec._warm_free_retries = warm_used + 1  # type: ignore[attr-defined]
+                    free = True
+            if st is not None and not st.get("cancelled") and \
+                    (free or st["retries_left"] > 0):
+                if not free:
+                    st["retries_left"] -= 1
                 spec.attempt_number += 1
-                logger.info("retrying task %s (%d left)", spec.task_id.hex()[:12], st["retries_left"])
+                logger.info("retrying task %s (%s)", spec.task_id.hex()[:12],
+                            "free: warm lease lost its worker" if free
+                            else f"{st['retries_left']} left")
                 await self._submit_spec(spec)
             else:
                 err = RayTaskError(

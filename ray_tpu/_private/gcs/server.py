@@ -42,6 +42,14 @@ class NodeInfo:
     alive: bool = True
     last_heartbeat: float = field(default_factory=time.monotonic)
     is_head: bool = False
+    # graceful drain (reference: DrainNode + autoscaler.proto reasons):
+    # a DRAINING node is still alive — in-flight work finishes — but
+    # takes no new leases/placements and is published so schedulers
+    # route around it before it dies
+    draining: bool = False
+    drain_reason: str = ""
+    drain_deadline: float = 0.0  # monotonic; 0 = not draining
+    drain_started_at: float = 0.0
     labels: Dict[str, str] = field(default_factory=dict)
     agent_port: int = 0  # per-node dashboard agent (dashboard/agent.py)
     # autoscaler signal (reference: GcsAutoscalerStateManager)
@@ -135,6 +143,14 @@ class GcsServer:
         from ray_tpu.observability.aggregator import EventAggregator
 
         self.cluster_events = EventAggregator()
+        # graceful drain bookkeeping: per-node orchestration tasks,
+        # completion events, and the bounded directory of primary
+        # copies pushed off drained nodes (oid_bin -> node_id)
+        from collections import OrderedDict
+
+        self._drain_migrations: Dict[str, Any] = {}
+        self._drain_done_events: Dict[str, asyncio.Event] = {}
+        self.moved_objects: Any = OrderedDict()
         # lease, not a latch: the autoscaler re-asserts every reconcile
         # round; if it dies, the flag expires and raylets fall back to
         # fail-fast infeasible errors instead of queueing forever
@@ -610,6 +626,9 @@ class GcsServer:
         self, node_id: str, available_resources: Dict[str, float],
         pending_shapes: Optional[List[Dict[str, float]]] = None,
         num_leases: int = 0,
+        draining: bool = False,
+        drain_remaining_s: float = 0.0,
+        drain_reason: str = "",
     ) -> dict:
         node = self.nodes.get(node_id)
         if node is None:
@@ -626,13 +645,36 @@ class GcsServer:
         else:
             node.idle_since = None
         if not node.alive:
+            if draining:
+                # a final heartbeat from a raylet whose drain we already
+                # completed (it is exiting): don't resurrect the node —
+                # and don't re-enter DRAINING, which would replay the
+                # completion through the watchdog
+                return {"ok": True, "shutdown": True}
             node.alive = True
             self._node_version += 1
+        if draining and not node.draining:
+            # a GCS restarted mid-drain relearns the DRAINING state from
+            # the raylet's heartbeats (nodes aren't persisted); the
+            # raylet keeps driving its own drain and will send
+            # NodeDrainComplete — no new orchestration task here, the
+            # health watchdog bounds a raylet that dies first
+            self._enter_draining(node, drain_reason, drain_remaining_s)
         # piggyback the cluster resource view so raylets can spill leases
         # to other nodes (reference: ray_syncer.h:91 resource broadcast)
-        return {"ok": True, "cluster": self._cluster_view(),
-                "autoscaling":
-                    time.monotonic() < self.autoscaler_enabled_until}
+        reply = {"ok": True, "cluster": self._cluster_view(),
+                 "autoscaling":
+                     time.monotonic() < self.autoscaler_enabled_until}
+        if node.draining and not draining:
+            # the GCS knows the node is draining but the raylet doesn't
+            # (the Drain RPC was lost): re-issue the instruction on the
+            # heartbeat reply
+            reply["drain"] = {
+                "reason": node.drain_reason,
+                "deadline_s": max(0.0,
+                                  node.drain_deadline - time.monotonic()),
+            }
+        return reply
 
     async def SetAutoscalerEnabled(self, enabled: bool,
                                    ttl_s: float = 30.0) -> dict:
@@ -649,6 +691,7 @@ class GcsServer:
             n.node_id: {
                 "addr": n.address,
                 "alive": n.alive,
+                "draining": n.draining,
                 "total": dict(n.total_resources),
                 "available": dict(n.available_resources),
             }
@@ -672,6 +715,7 @@ class GcsServer:
                 {
                     "node_id": n.node_id,
                     "alive": n.alive,
+                    "draining": n.draining,
                     "is_head": n.is_head,
                     "total": dict(n.total_resources),
                     "available": dict(n.available_resources),
@@ -686,18 +730,240 @@ class GcsServer:
             "pending_actors": pending_actors,
         }
 
-    async def DrainNode(self, node_id: str) -> dict:
+    # ------------------------------------------------------------------
+    # Graceful drain (reference: gcs_service.proto DrainNode with a
+    # deadline + DRAIN_NODE_REASON_PREEMPTION; _private/drain.py has the
+    # lifecycle). Planned node loss is a protocol, not a health-check
+    # timeout: the node stops taking work, in-flight work finishes or
+    # migrates, and only then is the node marked dead.
+    # ------------------------------------------------------------------
+    async def DrainNode(self, node_id: str, reason: str = "",
+                        deadline_s: Optional[float] = None) -> dict:
+        from ray_tpu._private import drain as drain_mod
+
         node = self.nodes.get(node_id)
-        if node:
-            node.alive = False
-            self._node_version += 1
+        if node is None or not node.alive:
+            return {"ok": False, "error": f"node {node_id[:12]} not alive"}
+        if deadline_s is None:
+            deadline_s = config.drain_deadline_default_s
+        reason = reason or drain_mod.REASON_IDLE_TERMINATION
+        # preempting one slice member preempts the whole slice: a TPU
+        # pod slice is one ICI failure domain (SlicePlacementGroup /
+        # JaxTrainer assume gang semantics), so the rest of the slice
+        # drains with it rather than limping on and timing out later
+        targets = [node]
+        slice_id = node.labels.get("slice_id")
+        if slice_id and reason == drain_mod.REASON_PREEMPTION:
+            for n in self.nodes.values():
+                if (n is not node and n.alive and not n.draining
+                        and n.labels.get("slice_id") == slice_id):
+                    targets.append(n)
+        started = []
+        for n in targets:
+            if n.draining:
+                continue
+            self._start_drain(n, reason, deadline_s)
+            started.append(n.node_id)
+        return {"ok": True, "draining": started,
+                "already_draining": node.draining and not started}
+
+    def _enter_draining(self, node: NodeInfo, reason: str,
+                        deadline_s: float) -> None:
+        """Single entry point for the DRAINING state (used by the
+        DrainNode orchestration, the heartbeat relearn after a GCS
+        restart, and a raylet-initiated completion the GCS never saw
+        start): sets the fields, bumps the node version, and publishes
+        — every observer sees the same transition."""
+        node.draining = True
+        node.drain_reason = reason
+        node.drain_started_at = time.monotonic()
+        node.drain_deadline = node.drain_started_at + max(0.0, deadline_s)
+        self._node_version += 1
+        self._publish_and_wake(
+            "node_state", node.node_id,
+            {"alive": True, "draining": True, "reason": reason})
+
+    def _start_drain(self, node: NodeInfo, reason: str,
+                     deadline_s: float) -> None:
+        from ray_tpu._private import drain as drain_mod
+
+        self._enter_draining(node, reason, deadline_s)
+        logger.info("draining node %s (%s, deadline %.1fs)",
+                    node.node_id[:12], reason, deadline_s)
+        self.cluster_events.add([{
+            "type": drain_mod.EVENT_DRAIN_START,
+            "ts": time.time(),
+            "node_id": node.node_id,
+            "reason": reason,
+            "deadline_s": deadline_s,
+        }])
+        asyncio.ensure_future(self._drain_node_task(node, reason, deadline_s))
+
+    def _drain_done_event(self, node_id: str) -> asyncio.Event:
+        ev = self._drain_done_events.get(node_id)
+        if ev is None:
+            ev = self._drain_done_events[node_id] = asyncio.Event()
+        return ev
+
+    async def _drain_node_task(self, node: NodeInfo, reason: str,
+                               deadline_s: float) -> None:
+        """Orchestrate one node's drain: tell the raylet, migrate the
+        actors, then wait for the raylet's completion (or the deadline)
+        before declaring the node dead."""
+        ev = self._drain_done_event(node.node_id)
+        try:
+            await self._raylet(node.node_id).acall(
+                "Drain", reason=reason, deadline_s=deadline_s, timeout=10)
+        except Exception as e:  # noqa: BLE001 — heartbeat replies carry
+            # the drain instruction as a fallback; the watchdog bounds it
+            logger.warning("Drain RPC to %s failed: %s",
+                           node.node_id[:12], e)
+        mig = asyncio.ensure_future(self._migrate_node_actors(node, reason))
+        self._drain_migrations[node.node_id] = mig
+        # wait for the raylet to confirm; the deadline plus a small
+        # grace bounds the wait (the health watchdog is the backstop
+        # when this task itself died with a restarted GCS)
+        remaining = max(0.0, node.drain_deadline - time.monotonic())
+        try:
+            await asyncio.wait_for(
+                ev.wait(), timeout=remaining + config.drain_watchdog_grace_s)
+        except asyncio.TimeoutError:
+            logger.warning("drain of %s hit its deadline without raylet "
+                           "confirmation", node.node_id[:12])
+        try:
+            await asyncio.wait_for(mig, timeout=5.0)
+        except (asyncio.TimeoutError, Exception):  # noqa: BLE001
+            pass
+        await self._finish_drain(node.node_id)
+
+    async def _migrate_node_actors(self, node: NodeInfo,
+                                   reason: str) -> None:
+        """Gracefully restart every ALIVE actor off the draining node:
+        the old instance first stops accepting and finishes its accepted
+        tasks (worker DrainActor), then the normal failure path restarts
+        it per max_restarts — with watchers woken by the published
+        actor_state event, not a health-check timeout."""
+        # only ALIVE actors need migration: a PENDING actor has run no
+        # code — its scheduling loop re-picks on its own (the draining
+        # node is excluded from _pick_node_for and rejects its lease),
+        # and routing it through _handle_actor_failure would charge (or
+        # at max_restarts=0, spend) a restart for a planned drain
+        victims = [a for a in self.actors.values()
+                   if a.node_id == node.node_id and a.state == "ALIVE"]
+        if not victims:
+            return
+        budget = max(0.5, node.drain_deadline - time.monotonic() - 1.0)
+
+        async def _one(actor: ActorInfo) -> None:
+            if actor.worker_addr:
+                try:
+                    await asyncio.wait_for(
+                        self._worker_client(tuple(actor.worker_addr)).acall(
+                            "DrainActor", actor_id=actor.actor_id,
+                            timeout_s=budget, timeout=budget + 5),
+                        timeout=budget + 6)
+                except Exception:  # noqa: BLE001 — worker already gone
+                    pass
+            a = self.actors.get(actor.actor_id)
+            if a is not None and a.node_id == node.node_id \
+                    and a.state == "ALIVE":
+                await self._handle_actor_failure(
+                    a, f"node {node.node_id[:12]} draining ({reason})")
+
+        await asyncio.gather(*(_one(a) for a in victims),
+                             return_exceptions=True)
+        logger.info("migrated %d actor(s) off draining node %s",
+                    len(victims), node.node_id[:12])
+
+    async def NodeDrainComplete(self, node_id: str,
+                                moved_objects: Optional[dict] = None) -> dict:
+        """Raylet-side drain finished: record where it pushed its
+        primary object copies, wait for the actor migration, and mark
+        the node dead. The raylet blocks on this reply before killing
+        its workers, so migration RPCs to them cannot race the exit."""
+        if moved_objects:
+            self._record_moved_objects(moved_objects)
+        mig = self._drain_migrations.get(node_id)
+        if mig is not None and not mig.done():
+            try:
+                await asyncio.wait_for(asyncio.shield(mig), timeout=30)
+            except (asyncio.TimeoutError, Exception):  # noqa: BLE001
+                pass
+        node = self.nodes.get(node_id)
+        if node is not None and node.alive and not node.draining:
+            # raylet-initiated drain that finished inside one heartbeat
+            # period (we never saw DRAINING): the raylet is exiting
+            # regardless — run the completion path so the node is
+            # cleanly dead instead of waiting out the health checker
+            self._enter_draining(node, node.drain_reason, 0.0)
+        if node is not None and node.draining:
+            self._drain_done_event(node_id).set()
+            await self._finish_drain(node_id)
         return {"ok": True}
+
+    _MOVED_OBJECTS_MAX = 20_000
+
+    def _record_moved_objects(self, moved: dict) -> None:
+        """Bounded oid_bin -> node_id directory of primary copies pushed
+        off drained nodes; owners consult it when a pull from the
+        recorded node fails (_pull_remote_object fallback)."""
+        table = self.moved_objects
+        for oid_bin, nid in moved.items():
+            table[bytes(oid_bin)] = nid
+            table.move_to_end(bytes(oid_bin))
+        while len(table) > self._MOVED_OBJECTS_MAX:
+            table.popitem(last=False)
+
+    async def LookupObjectLocations(self, object_id_bins: List[bytes]) -> dict:
+        table = self.moved_objects
+        return {
+            bytes(b): table[bytes(b)]
+            for b in object_id_bins if bytes(b) in table
+        }
+
+    async def _finish_drain(self, node_id: str) -> None:
+        from ray_tpu._private import drain as drain_mod
+
+        node = self.nodes.get(node_id)
+        if node is None or not node.draining:
+            return
+        node.draining = False
+        node.alive = False
+        node.drain_deadline = 0.0
+        self._node_version += 1
+        duration = time.monotonic() - (node.drain_started_at
+                                       or time.monotonic())
+        logger.info("drain of node %s complete (%.1fs)",
+                    node_id[:12], duration)
+        self.cluster_events.add([{
+            "type": drain_mod.EVENT_DRAIN_COMPLETE,
+            "ts": time.time(),
+            "node_id": node_id,
+            "reason": node.drain_reason,
+            "duration_s": round(duration, 3),
+        }])
+        self._publish_and_wake(
+            "node_state", node_id, {"alive": False, "drained": True})
+        self._drain_migrations.pop(node_id, None)
+        self._drain_done_events.pop(node_id, None)
+        # drop the cached raylet client — the daemon is exiting
+        c = self._raylet_clients.pop(node_id, None)
+        if c is not None:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001
+                pass
+        # any actor the migration missed fails over through the normal
+        # node-death path (idempotent for already-RESTARTING actors)
+        await self._on_node_death(node_id)
 
     async def GetAllNodeInfo(self) -> List[dict]:
         return [
             {
                 "NodeID": n.node_id,
                 "Alive": n.alive,
+                "Draining": n.draining,
+                "DrainReason": n.drain_reason if n.draining else "",
                 "NodeManagerAddress": n.address[0],
                 "NodeManagerPort": n.address[1],
                 "ObjectStoreSocketName": n.store_socket,
@@ -714,7 +980,7 @@ class GcsServer:
         total: Dict[str, float] = {}
         avail: Dict[str, float] = {}
         for n in self.nodes.values():
-            if not n.alive:
+            if not n.alive or n.draining:
                 continue
             for k, v in n.total_resources.items():
                 total[k] = total.get(k, 0.0) + v
@@ -732,9 +998,29 @@ class GcsServer:
         while True:
             await asyncio.sleep(period)
             now = time.monotonic()
-            for node in self.nodes.values():
+            for node in list(self.nodes.values()):
+                if node.alive and node.draining and \
+                        now > node.drain_deadline \
+                        + config.drain_watchdog_grace_s:
+                    # drain watchdog: past deadline + grace a DRAINING
+                    # node is force-completed (the raylet died mid-drain,
+                    # or a restarted GCS lost the orchestration task) —
+                    # no node sits DRAINING forever
+                    logger.warning(
+                        "node %s stuck DRAINING past its deadline; "
+                        "force-completing", node.node_id[:12])
+                    await self._finish_drain(node.node_id)
+                    continue
                 if node.alive and now - node.last_heartbeat > threshold:
                     logger.warning("node %s missed heartbeats; marking dead", node.node_id[:12])
+                    if node.draining:
+                        # a DRAINING node that stops heartbeating died
+                        # mid-drain: run the full completion path so the
+                        # NODE_DRAIN_COMPLETE event fires and the drain
+                        # bookkeeping (done events, migration task,
+                        # cached raylet client) is cleaned up
+                        await self._finish_drain(node.node_id)
+                        continue
                     node.alive = False
                     self._node_version += 1
                     self._publish_and_wake(
@@ -888,7 +1174,7 @@ class GcsServer:
             # any bundle's node with room
             for idx, nid in pg.bundle_nodes.items():
                 node = self.nodes.get(nid)
-                if node and node.alive:
+                if node and node.alive and not node.draining:
                     return nid
             return None
 
@@ -902,7 +1188,8 @@ class GcsServer:
                            for k, v in (actor.node_labels or {}).items())
             return True
 
-        alive = [n for n in self.nodes.values() if n.alive]
+        alive = [n for n in self.nodes.values()
+                 if n.alive and not n.draining]
         allowed = [n for n in alive if _matches(n)]
         if actor is not None and actor.strategy_soft:
             # soft: fall back when nothing matches OR the matches can
@@ -967,7 +1254,8 @@ class GcsServer:
         if pending < 2:
             return
         self._last_prestart = now
-        alive = [n for n in self.nodes.values() if n.alive]
+        alive = [n for n in self.nodes.values()
+                 if n.alive and not n.draining]
         if not alive:
             return
         per_node = max(1, min(config.actor_creation_concurrency,
@@ -1222,6 +1510,10 @@ class GcsServer:
             actor.num_restarts += 1
             actor.state = "RESTARTING"
             actor.worker_addr = None
+            # recorded for RESTARTING too: callers use it to tell a
+            # PLANNED restart (node drain — old instance finished its
+            # accepted work, safe to resend) from a crash
+            actor.death_cause = cause
             actor.version += 1
             self._notify_actor(actor.actor_id)
             logger.info("actor %s restarting (%d/%s): %s", actor.actor_id[:12], actor.num_restarts, actor.max_restarts, cause)
@@ -1294,7 +1586,8 @@ class GcsServer:
     def _plan_bundles(self, pg: PlacementGroupInfo) -> Optional[Dict[int, str]]:
         """Bin-pack bundles onto alive nodes per strategy (reference:
         bundle_scheduling_policy.h bundle pack/spread)."""
-        alive = [n for n in self.nodes.values() if n.alive]
+        alive = [n for n in self.nodes.values()
+                 if n.alive and not n.draining]
         if not alive:
             return None
         # simulate available resources

@@ -312,6 +312,17 @@ class Raylet:
         # reader that asked for the restore can pin them before the next
         # spill round picks them (they are sealed+unpinned+LRU-old)
         self._restore_grace: Dict[bytes, float] = {}
+        # graceful drain (reference: NodeManager::HandleDrainRaylet):
+        # once draining, no lease is ever granted again; in-flight task
+        # leases run out (bounded by the deadline), primary object
+        # copies are pushed to a survivor, then this daemon deregisters
+        # and exits
+        self.draining = False
+        self.drain_reason = ""
+        self.drain_deadline = 0.0
+        self._drain_task: Optional[asyncio.Task] = None
+        # inbound drain-pushed objects mid-transfer: oid_bin -> buffer
+        self._incoming_objects: Dict[bytes, Any] = {}
 
     # ------------------------------------------------------------------
     # Worker pool (reference: worker_pool.h:280)
@@ -385,6 +396,8 @@ class Raylet:
         then overlap the gated lease+CreateActor pipelines instead of
         running inside them; each spawned worker parks in the idle pool
         on registration and the next lease request grants instantly."""
+        if self.draining:
+            return {"started": 0}
         supply = len(self.idle_workers) + self._starting_workers
         room = (config.max_workers_per_node - len(self.workers)
                 - self._starting_workers)
@@ -513,6 +526,12 @@ class Raylet:
         hard_node_constraint: str = "",
         runtime_env_hash: str = "",
     ) -> dict:
+        if self.draining:
+            # a draining node grants nothing new; the redirect (when a
+            # survivor exists) lets the caller re-lease in one hop, and
+            # the caller's drain-aware retry never burns max_retries on it
+            return self._draining_reply(resources, pg_id=pg_id,
+                                        hard_node_constraint=hard_node_constraint)
         req = {
             "resources": dict(resources),
             "scheduling_class": scheduling_class,
@@ -633,7 +652,8 @@ class Raylet:
 
         candidates = []
         for nid, info in self.cluster_view.items():
-            if nid == self.node_id or not info.get("alive"):
+            if nid == self.node_id or not info.get("alive") \
+                    or info.get("draining"):
                 continue
             total = info.get("total", {})
             avail = info.get("available", {})
@@ -914,6 +934,255 @@ class Raylet:
         return {"ok": True}
 
     # ------------------------------------------------------------------
+    # Graceful drain (reference: NodeManager::HandleDrainRaylet +
+    # local_object_manager eviction-before-death; _private/drain.py has
+    # the cluster-wide lifecycle)
+    # ------------------------------------------------------------------
+    async def Drain(self, reason: str = "",
+                    deadline_s: Optional[float] = None) -> dict:
+        if self.draining:
+            return {"ok": True, "already": True}
+        if deadline_s is None:
+            deadline_s = config.drain_deadline_default_s
+        self.draining = True
+        self.drain_reason = reason
+        self.drain_deadline = time.monotonic() + max(0.0, deadline_s)
+        logger.info("draining (%s, deadline %.1fs): %d lease(s) in "
+                    "flight, %d pending", reason, deadline_s,
+                    len(self.leases), len(self.pending))
+        # queued lease requests will never be granted here: answer them
+        # NOW with a redirect so their callers re-lease elsewhere instead
+        # of burning their full wait timeout against a dying node
+        pending, self.pending = self.pending, []
+        for p in pending:
+            if p.future.done():
+                continue
+            try:
+                p.future.set_result(self._draining_reply(
+                    p.request.get("resources") or {},
+                    pg_id=p.request.get("pg_id"),
+                    hard_node_constraint=p.request.get(
+                        "hard_node_constraint", "")))
+            except asyncio.InvalidStateError:
+                pass
+        self._drain_task = asyncio.ensure_future(
+            self._drain_task_run())
+        return {"ok": True}
+
+    def _draining_reply(self, resources: Dict[str, float],
+                        pg_id: Optional[str] = None,
+                        hard_node_constraint: str = "") -> dict:
+        """Lease rejection for a draining node: carries a spillback
+        target when one exists so the caller's existing redirect path
+        re-leases elsewhere in one hop. PG-bundle and hard-constrained
+        requests (pinned NodeAffinity AND hard NodeLabel) are NEVER
+        redirected — the spillback picker filters on resources only, so
+        a redirect could land them on a node violating the constraint;
+        the normal path never spills them either. Their callers
+        retry/fail through the placement machinery instead."""
+        reply = {"granted": False, "draining": True,
+                 "error": "node is draining"}
+        if not pg_id and not hard_node_constraint:
+            target = self._pick_spillback(resources,
+                                          require_available=False)
+            if target is not None:
+                reply["spillback"] = target
+        return reply
+
+    async def _drain_task_run(self) -> None:
+        from ray_tpu._private import drain as drain_mod
+
+        # 0) recall warm leases: tell every worker to refuse further
+        # task pushes (node_draining reply) — the callers holding
+        # keepalive-cached leases return them and re-lease elsewhere,
+        # so a sustained task stream doesn't pin its lease here for the
+        # whole deadline (and then die mid-task at the kill)
+        async def _notify(w: WorkerHandle) -> None:
+            if w.addr is None or w.dead:
+                return
+            c = RpcClient(w.addr[0], w.addr[1], self._loop_handle())
+            try:
+                await c.acall("NotifyNodeDraining", timeout=5)
+            except Exception:  # noqa: BLE001 — worker already gone
+                pass
+            finally:
+                c.close()
+
+        await asyncio.gather(
+            *(_notify(w) for w in list(self.workers.values())),
+            return_exceptions=True)
+        # 1) let in-flight TASK leases run out (actor leases are
+        # migrated by the GCS in parallel — their workers are torn down
+        # at exit below). Idle warm leases held by callers come back via
+        # their keepalive sweepers within worker_lease_keepalive_s.
+        while time.monotonic() < self.drain_deadline:
+            task_leases = [l for l in self.leases.values()
+                           if not l.for_actor]
+            if not task_leases:
+                break
+            await asyncio.sleep(0.05)
+        # 2) push primary object copies to a surviving node so borrowed
+        # refs outlive this node (skipped on whole-cluster shutdown —
+        # there is nobody left to read them)
+        moved: Dict[bytes, str] = {}
+        if self.drain_reason != drain_mod.REASON_CLUSTER_SHUTDOWN:
+            target = self._pick_drain_target()
+            if target is not None:
+                loop = asyncio.get_event_loop()
+                try:
+                    moved = await loop.run_in_executor(
+                        None, self._push_objects_sync, target)
+                except Exception:  # noqa: BLE001
+                    logger.exception("drain object push failed")
+        # 3) confirm to the GCS (it finishes actor migration before
+        # replying, so worker teardown below cannot race a DrainActor),
+        # then deregister by exiting cleanly
+        deadline = time.monotonic() + 45.0
+        while time.monotonic() < deadline:
+            try:
+                await self.gcs.acall(
+                    "NodeDrainComplete", node_id=self.node_id,
+                    moved_objects=moved, timeout=40)
+                break
+            except Exception as e:  # noqa: BLE001 — GCS restarting;
+                # its heartbeat-relearned DRAINING state + watchdog
+                # cover a confirmation that never lands
+                logger.warning("NodeDrainComplete failed: %s", e)
+                await asyncio.sleep(1.0)
+        logger.info("drain complete; raylet exiting")
+        self.shutdown_procs()
+        # give the log line and any in-flight response frames a beat
+        asyncio.get_event_loop().call_later(0.2, os._exit, 0)
+
+    def _pick_drain_target(self) -> Optional[Tuple[str, int]]:
+        """A surviving (alive, not draining) node's raylet address."""
+        best = None
+        for nid, info in self.cluster_view.items():
+            if nid == self.node_id or not info.get("alive") \
+                    or info.get("draining"):
+                continue
+            mem = info.get("available", {}).get("memory", 0.0)
+            if best is None or mem > best[0]:
+                best = (mem, tuple(info["addr"]), nid)
+        if best is None:
+            return None
+        self._drain_target_node_id = best[2]
+        return best[1]
+
+    def _push_objects_sync(self, target: Tuple[str, int]) -> Dict[bytes, str]:
+        """Push every sealed primary copy (in-memory and spilled) to the
+        target raylet's store, chunked. Runs on an executor thread;
+        returns oid_bin -> destination node id for the GCS directory."""
+        from ray_tpu._private.ids import ObjectID
+        from ray_tpu._private.rpc import get_client
+
+        client = get_client(target)
+        target_nid = getattr(self, "_drain_target_node_id", "")
+        chunk = config.object_pull_chunk_bytes
+        moved: Dict[bytes, str] = {}
+
+        def _send(oid_bin: bytes, total: int, read) -> bool:
+            off = 0
+            while off < total or off == 0:
+                data = read(off, min(chunk, total - off))
+                if data is None:
+                    return False
+                rep = client.call(
+                    "ReceiveObjectChunk", object_id_bin=oid_bin,
+                    offset=off, total=total, data=data, timeout=60)
+                if rep.get("status") == "exists":
+                    return True  # already there (e.g. a reader pulled it)
+                if rep.get("status") != "ok":
+                    return False
+                off += max(1, len(data))
+                if total == 0:
+                    break
+            return True
+
+        try:
+            candidates = self.store.list_objects()
+        except Exception:  # noqa: BLE001
+            candidates = []
+        for oid_bin, size, sealed, _pinned in candidates:
+            if not sealed:
+                continue
+            oid = ObjectID(oid_bin)
+            [view] = self.store.get([oid], timeout_ms=0)
+            if view is None:
+                continue
+            try:
+                if _send(bytes(oid_bin), len(view),
+                         lambda o, n, v=view: bytes(v[o:o + n])):
+                    moved[bytes(oid_bin)] = target_nid
+            except Exception:  # noqa: BLE001 — best effort per object
+                pass
+            finally:
+                try:
+                    self.store.release(oid)
+                except Exception:  # noqa: BLE001
+                    pass
+        with self._spill_lock:
+            spilled = dict(self.spilled)
+        for oid_bin, (path, size) in spilled.items():
+            def _read_file(off, n, path=path):
+                try:
+                    with open(path, "rb") as f:
+                        f.seek(off)
+                        return f.read(n)
+                except OSError:
+                    return None
+            try:
+                if _send(bytes(oid_bin), size, _read_file):
+                    moved[bytes(oid_bin)] = target_nid
+            except Exception:  # noqa: BLE001
+                pass
+        if moved:
+            logger.info("drain pushed %d primary object(s) to %s",
+                        len(moved), target_nid[:12])
+        return moved
+
+    async def ReceiveObjectChunk(self, object_id_bin: bytes, offset: int,
+                                 total: int, data: bytes) -> dict:
+        """Destination side of the drain push: write the chunk into this
+        node's store (Create at offset 0, Seal on the last chunk)."""
+        from ray_tpu._private.ids import ObjectID
+
+        oid_bin = bytes(object_id_bin)
+        oid = ObjectID(oid_bin)
+        loop = asyncio.get_event_loop()
+
+        def _write() -> str:
+            ent = self._incoming_objects.get(oid_bin)
+            if ent is None:
+                if offset != 0:
+                    return "bad_offset"
+                try:
+                    if self.store.contains(oid):
+                        return "exists"
+                    buf = self.store.create(oid, total)
+                except FileExistsError:
+                    return "exists"
+                except Exception:  # noqa: BLE001 — store full
+                    self._spill_until(total)
+                    try:
+                        buf = self.store.create(oid, total)
+                    except Exception:  # noqa: BLE001
+                        return "full"
+                ent = self._incoming_objects[oid_bin] = {
+                    "buf": buf, "last_used": time.monotonic()}
+            buf = ent["buf"]
+            ent["last_used"] = time.monotonic()
+            if data:
+                buf.data[offset:offset + len(data)] = data
+            if offset + len(data) >= total:
+                buf.seal()
+                del self._incoming_objects[oid_bin]
+            return "ok"
+
+        status = await loop.run_in_executor(None, _write)
+        return {"status": status}
+
+    # ------------------------------------------------------------------
     # Object manager: serve chunked pulls from this node's store to other
     # nodes (reference: src/ray/object_manager/object_manager.cc:221 Pull,
     # :587 HandlePush — ours is pull-based: the reader drives the transfer)
@@ -1137,7 +1406,10 @@ class Raylet:
         return size, data
 
     async def _pull_pin_sweeper_loop(self) -> None:
-        """Release transfer pins whose readers died mid-pull."""
+        """Release transfer pins whose readers died mid-pull, and abort
+        inbound drain-pushed buffers whose sender died mid-transfer (a
+        hard-killed draining node must not leak an unsealed allocation
+        on the survivor forever)."""
         while True:
             await asyncio.sleep(10)
             cutoff = time.monotonic() - 60
@@ -1152,6 +1424,13 @@ class Raylet:
                     self.store.release(oid)
                 except Exception:  # noqa: BLE001
                     pass
+            for oid_bin, ent in list(self._incoming_objects.items()):
+                if ent["last_used"] < cutoff:
+                    self._incoming_objects.pop(oid_bin, None)
+                    try:
+                        ent["buf"].abort()
+                    except Exception:  # noqa: BLE001
+                        pass
 
     async def DeleteObject(self, object_id_bin: bytes) -> dict:
         from ray_tpu._private.ids import ObjectID
@@ -1187,6 +1466,8 @@ class Raylet:
             "spilled_bytes_total": self._spilled_bytes_total,
             "restored_bytes_total": self._restored_bytes_total,
             "num_oom_kills": self.num_oom_kills,
+            "draining": self.draining,
+            "drain_reason": self.drain_reason,
         }
 
     async def Ping(self) -> str:
@@ -1207,6 +1488,11 @@ class Raylet:
                     available_resources=self.resources.available,
                     pending_shapes=shapes,
                     num_leases=len(self.leases),
+                    draining=self.draining,
+                    drain_remaining_s=max(
+                        0.0, self.drain_deadline - time.monotonic())
+                    if self.draining else 0.0,
+                    drain_reason=self.drain_reason,
                     timeout=10,
                 )
                 if reply.get("reregister"):
@@ -1217,6 +1503,12 @@ class Raylet:
                 if "autoscaling" in reply:
                     # absent on reregister replies — don't flip to False
                     self.autoscaling_enabled = bool(reply["autoscaling"])
+                drain = reply.get("drain")
+                if drain is not None and not self.draining:
+                    # the GCS-side Drain RPC never reached us (lost, or
+                    # we restarted): the heartbeat reply re-issues it
+                    await self.Drain(reason=drain.get("reason", ""),
+                                     deadline_s=drain.get("deadline_s"))
             except Exception as e:  # noqa: BLE001
                 logger.warning("heartbeat failed: %s", e)
             await asyncio.sleep(period)
@@ -1268,6 +1560,8 @@ class Raylet:
         30s after the last grant, so a finished burst's spares idle out
         through the normal reaper instead of flapping."""
         now = time.monotonic()
+        if self.draining:
+            return
         if now - self._recent_lease_ts > 30.0:
             self._recent_lease_peak = 0
             return

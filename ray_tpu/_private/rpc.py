@@ -87,8 +87,10 @@ def _chaos_action(method: str) -> Optional[str]:
     Spec: comma list of ``Method=prob[:kind]`` where kind is
     ``request`` (drop before the handler runs — the default),
     ``response`` (handler runs, reply is dropped — side effects happen,
-    the caller sees a timeout), or ``delay:<ms>`` (in-flight latency).
-    Mirrors the reference's Request/Response/InFlight failure kinds
+    the caller sees a timeout), ``delay:<ms>`` (in-flight latency), or a
+    bare number — ``Method=prob:delay_ms`` — which is shorthand for the
+    delay kind (latency injection, not a failure). Mirrors the
+    reference's Request/Response/InFlight failure kinds
     (src/ray/rpc/rpc_chaos.h:8).
     """
     spec = config.testing_rpc_failure
@@ -105,9 +107,15 @@ def _chaos_action(method: str) -> Optional[str]:
             prob = float(bits[0])
         except ValueError:
             return None
-        if random.random() < prob:
-            return bits[1] if len(bits) > 1 else "request"
-        return None
+        if random.random() >= prob:
+            return None
+        if len(bits) == 1:
+            return "request"
+        kind = bits[1]
+        try:
+            return f"delay:{float(kind):g}"  # Method=prob:delay_ms form
+        except ValueError:
+            return kind
     return None
 
 

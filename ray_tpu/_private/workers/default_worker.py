@@ -568,6 +568,13 @@ class WorkerServer:
         # cannot target a thread that already moved on to another task
         self._running_tasks: Dict[bytes, int] = {}
         self._cancel_lock = threading.Lock()
+        # node drain recall: once set, task pushes are refused with a
+        # node_draining reply — the caller returns the warm lease and
+        # re-leases elsewhere for free, so a sustained task stream
+        # doesn't pin its lease to the dying node for the full deadline
+        self._node_draining = False
+        core.server.register("NotifyNodeDraining", self.NotifyNodeDraining,
+                             inline=True)
         core.server.register("PushTask", self.PushTask)
         core.server.register("PushTaskBatch", self.PushTaskBatch)
         core.server.register("CancelTask", self.CancelTask)
@@ -582,6 +589,7 @@ class WorkerServer:
         core.server.register("QueryActorTaskResult",
                              self.QueryActorTaskResult, inline=True)
         core.server.register("KillActor", self.KillActor)
+        core.server.register("DrainActor", self.DrainActor)
         core.server.register("SetLeaseContext", self.SetLeaseContext)
         core.server.register("Exit", self.Exit)
 
@@ -680,7 +688,13 @@ class WorkerServer:
                 self._fn_by_key.popitem(last=False)
         return fn, None
 
+    def NotifyNodeDraining(self) -> dict:
+        self._node_draining = True
+        return {"ok": True}
+
     def PushTask(self, spec_payload: dict) -> dict:
+        if self._node_draining:
+            return {"node_draining": True}
         self._apply_py_paths(spec_payload.get("py_paths"))
         self._apply_runtime_env(spec_payload.get("runtime_env"))
         fn, err_reply = self._resolve_function(spec_payload)
@@ -737,12 +751,15 @@ class WorkerServer:
         the positional ``replies`` in the final return are the reliable
         fallback for a lost push — the caller claims each (task,
         attempt) exactly once."""
+        if self._node_draining:
+            return {"node_draining": True}
         replies = []
         for p in spec_payloads:
             r = self.PushTask(p)
             replies.append(r)
             addr = p.get("caller_addr")
-            if addr and not r.get("need_function"):
+            if addr and not r.get("need_function") \
+                    and not r.get("node_draining"):
                 try:
                     get_client(tuple(addr)).call_oneway(
                         "NormalTaskDone",
@@ -826,6 +843,27 @@ class WorkerServer:
         if runner is None:
             return {"status": "unknown"}
         return runner.query(task_id_bin)
+
+    def DrainActor(self, actor_id: str, timeout_s: float = 30.0) -> dict:
+        """Graceful actor handoff for a draining node: stop accepting
+        new tasks (PushActorTasks answers accepted=False, so callers
+        re-resolve to the restarted incarnation) and wait for every
+        ACCEPTED task to finish — their results are still delivered /
+        queryable, so a drain loses no in-flight actor call. The GCS
+        restarts the actor elsewhere only after this returns."""
+        runner = self.actors.get(actor_id)
+        if runner is None:
+            return {"ok": True, "absent": True}
+        runner.dead = True  # gates acceptance only; the pool keeps running
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        while time.monotonic() < deadline:
+            with runner.lock:
+                if not runner.inflight:
+                    break
+            time.sleep(0.02)
+        with runner.lock:
+            leftover = len(runner.inflight)
+        return {"ok": True, "drained": leftover == 0, "inflight": leftover}
 
     def KillActor(self, actor_id: str) -> dict:
         runner = self.actors.pop(actor_id, None)

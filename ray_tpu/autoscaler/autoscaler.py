@@ -77,7 +77,11 @@ def compute_scaling_decision(
     node_slices = node_slices or {}
     node_type_map = node_type_map or {}
     booting = booting or {}
-    nodes = [n for n in demand.get("nodes", []) if n.get("alive")]
+    # DRAINING nodes are on their way out: their capacity must not
+    # absorb simulated demand (it would under-launch), and they are
+    # never idle-termination candidates (already terminating)
+    nodes = [n for n in demand.get("nodes", [])
+             if n.get("alive") and not n.get("draining")]
     shapes: List[Dict[str, float]] = []
     for n in nodes:
         shapes.extend(n.get("pending_shapes", []))
@@ -204,6 +208,9 @@ class Autoscaler:
         self._launched: Dict[str, Tuple[str, str]] = {}
         self._launch_times: Dict[str, float] = {}
         self.boot_grace_s = 120.0  # credit booting nodes this long
+        # graceful-drain deadline for idle terminations (idle nodes hold
+        # no leases; the drain is just the deregister handshake)
+        self.drain_deadline_s = 5.0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.num_launches = 0
@@ -305,12 +312,18 @@ class Autoscaler:
             pids = [p for p in pids if p not in killed]
             if not pids and not (sid and sid in killed_sids):
                 continue  # not ours (e.g. manually added node)
-            # drain EVERY GCS member of a terminated slice, including
-            # those whose provider host was already destroyed by an
-            # earlier iteration — otherwise the cluster view keeps
-            # spilling leases to a dead host until heartbeat timeout
+            # gracefully drain EVERY GCS member of a terminated slice,
+            # including those whose provider host was already destroyed
+            # by an earlier iteration — otherwise the cluster view keeps
+            # spilling leases to a dead host until heartbeat timeout.
+            # Idle nodes quiesce in seconds; the short deadline bounds
+            # the window before the provider hard-terminates below.
             try:
-                self.gcs.call("DrainNode", node_id=nid, timeout=5)
+                from ray_tpu._private.drain import REASON_IDLE_TERMINATION
+
+                self.gcs.call("DrainNode", node_id=nid,
+                              reason=REASON_IDLE_TERMINATION,
+                              deadline_s=self.drain_deadline_s, timeout=5)
             except Exception:  # noqa: BLE001
                 pass
             if sid:

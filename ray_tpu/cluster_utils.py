@@ -142,16 +142,33 @@ class Cluster:
         self.nodes.append(node)
         return node
 
-    def remove_node(self, node: ClusterNode, allow_graceful: bool = False) -> None:
+    def remove_node(self, node: ClusterNode, allow_graceful: bool = False,
+                    drain_deadline_s: float = 5.0) -> None:
         """Kill a node's raylet (and its store + workers), simulating node
-        failure. The GCS notices via missed heartbeats."""
+        failure. ``allow_graceful`` runs the full drain protocol first
+        (_private/drain.py): the node stops taking leases, in-flight work
+        finishes or migrates, and the raylet deregisters and exits on its
+        own — the kill below is only the backstop. Without it the GCS
+        notices via missed heartbeats, as for a crash."""
         if allow_graceful:
+            from ray_tpu._private.drain import REASON_IDLE_TERMINATION
+
+            client = RpcClient("127.0.0.1", self.gcs_port)
             try:
-                RpcClient("127.0.0.1", self.gcs_port).call(
-                    "DrainNode", node_id=node.node_id, timeout=5
+                client.call(
+                    "DrainNode", node_id=node.node_id,
+                    reason=REASON_IDLE_TERMINATION,
+                    deadline_s=drain_deadline_s, timeout=5,
                 )
+                deadline = time.monotonic() + drain_deadline_s + 3.0
+                while time.monotonic() < deadline:
+                    if node.proc.poll() is not None:
+                        break
+                    time.sleep(0.05)
             except Exception:
                 pass
+            finally:
+                client.close()
         kill_process_tree(node.proc, force=not allow_graceful)
         if node in self.nodes:
             self.nodes.remove(node)
@@ -173,6 +190,32 @@ class Cluster:
         raise TimeoutError(f"nodes did not come up: want {want}")
 
     def shutdown(self) -> None:
+        """Tear the cluster down via a short graceful drain, then kill.
+        Draining first quiesces lease grants and worker spawns, so the
+        kills below land on idle daemons instead of racing in-flight
+        RPCs (the shutdown-order "Task was destroyed" class of noise on
+        busy clusters); the CLUSTER_SHUTDOWN reason skips the object
+        push — nobody is left to read the copies."""
+        if self.gcs_proc is not None and self.nodes:
+            from ray_tpu._private.drain import REASON_CLUSTER_SHUTDOWN
+
+            client = RpcClient("127.0.0.1", self.gcs_port)
+            try:
+                for node in self.nodes:
+                    client.call(
+                        "DrainNode", node_id=node.node_id,
+                        reason=REASON_CLUSTER_SHUTDOWN,
+                        deadline_s=0.2, timeout=2,
+                    )
+                # brief window for the raylets to quiesce and self-exit
+                deadline = time.monotonic() + 1.5
+                while time.monotonic() < deadline and any(
+                        n.proc.poll() is None for n in self.nodes):
+                    time.sleep(0.05)
+            except Exception:
+                pass
+            finally:
+                client.close()
         for node in list(self.nodes):
             kill_process_tree(node.proc)
         self.nodes.clear()

@@ -95,6 +95,38 @@ class SlicePlacementGroup:
             except Exception:
                 pass
 
+    def drain(self, deadline_s: Optional[float] = None,
+              slice_index: Optional[int] = None) -> List[str]:
+        """Gracefully drain the hosts backing this reservation — the
+        whole ICI failure domain at once (a preempted slice member never
+        survives alone; reference: DrainNode with
+        DRAIN_NODE_REASON_PREEMPTION). ``slice_index`` limits the drain
+        to one slice of a multislice reservation. Returns the drained
+        node ids; the gang's workers restart per their max_restarts once
+        replacement capacity registers."""
+        from ray_tpu._private import worker as worker_mod
+        from ray_tpu._private.drain import REASON_PREEMPTION
+
+        core = worker_mod._require_connected().core
+        pgs = (self._pgs if slice_index is None
+               else [self._pgs[slice_index]])
+        node_ids: List[str] = []
+        for pg in pgs:
+            info = core.get_placement_group_info(pg.id()) or {}
+            for nid in (info.get("bundle_nodes") or {}).values():
+                if nid not in node_ids:
+                    node_ids.append(nid)
+        drained: List[str] = []
+        for nid in node_ids:
+            try:
+                rep = core.gcs.call_retrying(
+                    "DrainNode", node_id=nid, reason=REASON_PREEMPTION,
+                    deadline_s=deadline_s)
+            except Exception:  # noqa: BLE001
+                continue
+            drained.extend(rep.get("draining") or [])
+        return drained
+
     def host_group_specs(self, coordinator_address: str) -> List[HostGroupSpec]:
         """jax.distributed + MEGASCALE bootstrap specs for every host
         process in the gang (reference: get_tpu_coordinator_env_vars

@@ -128,6 +128,89 @@ def bench_many_pgs(n_pgs: int) -> dict:
     }
 
 
+def bench_preempt_1of2_nodes(n_tasks: int) -> dict:
+    """Recovery-time benchmark: a 2-node cluster under a steady task
+    wave loses one node to a graceful preemption drain mid-run.
+    Records how long the drain took, how long until the first full
+    post-drain wave completed (recovery latency, tracked like
+    throughput), and an ``app_errors`` count — expected 0; the
+    preemption soak test is what ENFORCES the zero-error bar, the
+    bench row just records it next to the throughput envelope."""
+    import ray_tpu
+    from ray_tpu._private.drain import (
+        EVENT_DRAIN_COMPLETE,
+        REASON_PREEMPTION,
+    )
+    from ray_tpu._private.rpc import RpcClient
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util import state as rstate
+
+    cluster = Cluster()
+    cluster.add_node(num_cpus=4)
+    n2 = cluster.add_node(num_cpus=4)
+    cluster.wait_for_nodes()
+    gcs = RpcClient("127.0.0.1", cluster.gcs_port)
+    try:
+        ray_tpu.init(address=cluster.address)
+
+        @ray_tpu.remote(max_retries=3)
+        def work(x):
+            return x * 2
+
+        wave = 200
+
+        def run_wave():
+            t0 = time.perf_counter()
+            out = ray_tpu.get([work.remote(i) for i in range(wave)],
+                              timeout=600)
+            assert out == [i * 2 for i in range(wave)]
+            return time.perf_counter() - t0
+
+        # baseline throughput on two nodes
+        run_wave()  # warm
+        base_s = min(run_wave() for _ in range(3))
+        done = 0
+        errors = 0
+        t_drain = time.perf_counter()
+        gcs.call("DrainNode", node_id=n2.node_id,
+                 reason=REASON_PREEMPTION, deadline_s=10.0, timeout=10)
+        # steady load across the whole drain window
+        node_dead_s = None
+        while done < n_tasks or node_dead_s is None:
+            try:
+                run_wave()
+            except Exception:  # noqa: BLE001
+                errors += 1
+            done += wave
+            if node_dead_s is None:
+                infos = gcs.call("GetAllNodeInfo", timeout=10)
+                i2 = next(i for i in infos if i["NodeID"] == n2.node_id)
+                if not i2["Alive"]:
+                    node_dead_s = time.perf_counter() - t_drain
+            if time.perf_counter() - t_drain > 120:
+                break
+        # first full wave entirely AFTER the node died = recovered
+        post_s = run_wave()
+        recovery_s = time.perf_counter() - t_drain
+        evs = [e for e in rstate.list_events()
+               if e["type"] == EVENT_DRAIN_COMPLETE]
+        drain_s = evs[-1]["duration_s"] if evs else None
+        return {
+            "tasks_through_drain": done,
+            "app_errors": errors,
+            "baseline_wave_s": round(base_s, 3),
+            "post_drain_wave_s": round(post_s, 3),
+            "drain_complete_s": drain_s,
+            "node_dead_s": round(node_dead_s, 3)
+            if node_dead_s is not None else None,
+            "recovery_s": round(recovery_s, 3),
+        }
+    finally:
+        gcs.close()
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
 def bench_combined(n_tasks: int, n_actors: int) -> dict:
     """The mixed-phase shape: a 100k-task phase then a 2,000-actor phase
     through ONE driver (the reference's release suite runs them as
@@ -164,6 +247,11 @@ def _run_phase(phase: str, n: int, n2: int = 0) -> None:
     os.environ.setdefault("RAY_TPU_ACTOR_SCHEDULE_TIMEOUT_S", "1800")
     import ray_tpu
 
+    if phase == "preempt_1of2_nodes":
+        # builds (and tears down) its own 2-node cluster
+        out = bench_preempt_1of2_nodes(n)
+        print("PHASE_JSON " + json.dumps(out), flush=True)
+        return
     ray_tpu.init(num_cpus=8)
     if phase == "combined":
         out = bench_combined(n, n2)
@@ -185,6 +273,10 @@ def main() -> None:
                     help="internal: run one phase in this process")
     ap.add_argument("--n", type=int, default=0)
     ap.add_argument("--n2", type=int, default=0)
+    ap.add_argument("--only", default="",
+                    help="run just this phase and MERGE its row into "
+                         "--out (recovery tracking without re-running "
+                         "the throughput envelope)")
     args = ap.parse_args()
 
     if args.phase:
@@ -198,16 +290,27 @@ def main() -> None:
     n_tasks = max(1000, int(100_000 * args.scale))
     n_actors = max(50, int(2_000 * args.scale))
     n_pgs = max(10, int(200 * args.scale))
+    n_preempt = max(400, int(2_000 * args.scale))
 
     # one DRIVER PROCESS per phase, like the reference's release suite
     # (release_tests.yaml runs many_tasks / many_actors / many_pgs as
     # separate jobs): each phase measures a clean control plane, not the
     # previous phase's leftover driver state
-    results = {}
-    for phase, n, n2 in (("many_tasks", n_tasks, 0),
-                         ("many_actors", n_actors, 0),
-                         ("many_pgs", n_pgs, 0),
-                         ("combined", n_tasks, n_actors)):
+    all_phases = (("many_tasks", n_tasks, 0),
+                  ("many_actors", n_actors, 0),
+                  ("many_pgs", n_pgs, 0),
+                  ("combined", n_tasks, n_actors),
+                  ("preempt_1of2_nodes", n_preempt, 0))
+    if args.only:
+        all_phases = tuple(p for p in all_phases if p[0] == args.only)
+        try:
+            with open(args.out) as f:
+                results = json.load(f)
+        except (OSError, ValueError):
+            results = {}
+    else:
+        results = {}
+    for phase, n, n2 in all_phases:
         print(f"== {phase}: {n}{f'+{n2}' if n2 else ''} ==", flush=True)
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__),

@@ -51,6 +51,77 @@ class TestRpcChaos:
         assert _run_workload(10) == [i * i for i in range(10)]
 
 
+class TestChaosSpecParsing:
+    """Unit coverage for every ``testing_rpc_failure`` form — failure
+    kinds AND the latency forms (``delay:<ms>`` and the bare-number
+    ``method=prob:delay_ms`` shorthand)."""
+
+    def _action(self, spec, method="M"):
+        from ray_tpu._private.rpc import _chaos_action
+
+        old = config.testing_rpc_failure
+        config.testing_rpc_failure = spec
+        try:
+            return _chaos_action(method)
+        finally:
+            config.testing_rpc_failure = old
+
+    def test_request_drop_default_kind(self):
+        assert self._action("M=1.0") == "request"
+        assert self._action("M=0.0") is None
+
+    def test_response_drop(self):
+        assert self._action("M=1.0:response") == "response"
+
+    def test_delay_explicit_form(self):
+        assert self._action("M=1.0:delay:250") == "delay:250"
+
+    def test_delay_ms_shorthand(self):
+        # method=prob:delay_ms — a bare number is injected latency
+        assert self._action("M=1.0:250") == "delay:250"
+        assert self._action("M=1.0:12.5") == "delay:12.5"
+
+    def test_wildcard_and_non_matching(self):
+        assert self._action("*=1.0:80", method="Anything") == "delay:80"
+        assert self._action("Other=1.0") is None
+
+    def test_comma_list_first_match_wins(self):
+        assert self._action("A=0.0,M=1.0:40,M=1.0:response") == "delay:40"
+
+    def test_malformed_prob_is_ignored(self):
+        assert self._action("M=notanumber") is None
+
+    def test_delay_injects_real_latency_end_to_end(self):
+        """A live RpcServer must actually hold the call for the injected
+        delay (slow-network paths are testable, not just failures)."""
+        import threading
+        import time as _t
+
+        from ray_tpu._private.rpc import EventLoopThread, RpcClient, RpcServer
+
+        server = RpcServer(name="chaos-delay-test")
+        server.register("Echo", lambda v: v)
+        loop = EventLoopThread(name="chaos-delay-io")
+        server.start(loop)
+        client = RpcClient(server.host, server.port)
+        old = config.testing_rpc_failure
+        try:
+            assert client.call("Echo", v=1, timeout=10) == 1  # warm conn
+            config.testing_rpc_failure = "Echo=1.0:150"
+            t0 = _t.monotonic()
+            assert client.call("Echo", v=2, timeout=10) == 2
+            assert _t.monotonic() - t0 >= 0.14
+            config.testing_rpc_failure = "Echo=1.0:delay:150"
+            t0 = _t.monotonic()
+            assert client.call("Echo", v=3, timeout=10) == 3
+            assert _t.monotonic() - t0 >= 0.14
+        finally:
+            config.testing_rpc_failure = old
+            client.close()
+            server.stop()
+            loop.stop()
+
+
 class TestProcessChaos:
     def test_workload_survives_worker_kills(self):
         from ray_tpu._private.chaos import WorkerKiller, kill_random_worker
@@ -116,6 +187,112 @@ class TestProcessChaos:
             except Exception:
                 pass
             cluster.shutdown()
+
+
+def _preemption_soak(n_tasks: int, n_actor_calls: int, deadline_s: float,
+                     task_sleep_s: float = 0.05) -> None:
+    """Core of the preemption soak: a 2-node cluster under mixed
+    task+actor load survives one seeded, deadline-jittered preemption
+    with ZERO application-visible errors — every task and actor call
+    succeeds, the actor restarts elsewhere, and the drain shows up on
+    the event bus."""
+    from ray_tpu._private.chaos import PreemptionInjector
+    from ray_tpu._private.drain import (
+        EVENT_DRAIN_COMPLETE,
+        EVENT_DRAIN_START,
+    )
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util import state as rstate
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2)
+    n2 = cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes()
+    try:
+        ray_tpu.init(address=cluster.address)
+
+        @ray_tpu.remote(max_retries=3)
+        def work(x):
+            import time as _t
+
+            _t.sleep(task_sleep_s)
+            return x * 2
+
+        @ray_tpu.remote(max_restarts=3)
+        class Stateful:
+            def bump(self, x):
+                return x + 1
+
+        actor = Stateful.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                n2.node_id, soft=True)).remote()
+        assert ray_tpu.get(actor.bump.remote(0), timeout=120) == 1
+
+        injector = PreemptionInjector(
+            cluster, interval_s=1.0, max_preemptions=1, seed=42,
+            deadline_s=deadline_s, jitter_s=deadline_s / 4)
+        errors = []
+        results = {"tasks": 0, "actor_calls": 0}
+        injector.start()
+        try:
+            # interleave task waves with actor calls, and KEEP the load
+            # up until the preemption has fired and completed — the soak
+            # is about surviving the drain, not finishing before it
+            wave = max(4, n_tasks // 10)
+            hard_stop = time.monotonic() + 180
+            while (results["tasks"] < n_tasks
+                   or results["actor_calls"] < n_actor_calls
+                   or not injector.preempted) and \
+                    time.monotonic() < hard_stop:
+                refs = [work.remote(i) for i in range(wave)]
+                acalls = [actor.bump.remote(j) for j in range(2)]
+                try:
+                    vals = ray_tpu.get(refs, timeout=240)
+                    assert vals == [i * 2 for i in range(len(refs))]
+                    results["tasks"] += len(refs)
+                except Exception as e:  # noqa: BLE001
+                    errors.append(("task", repr(e)))
+                    results["tasks"] += len(refs)
+                for j, r in enumerate(acalls):
+                    try:
+                        assert ray_tpu.get(r, timeout=240) == j + 1
+                        results["actor_calls"] += 1
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(("actor", repr(e)))
+                        results["actor_calls"] += 1
+        finally:
+            injector.stop()
+        assert injector.preempted, "chaos never fired"
+        assert not errors, f"application-visible errors: {errors[:5]}"
+        types = [e["type"] for e in rstate.list_events()]
+        assert EVENT_DRAIN_START in types
+        assert EVENT_DRAIN_COMPLETE in types
+        # the actor survived the preemption (restarted if it was hit)
+        assert ray_tpu.get(actor.bump.remote(10), timeout=120) == 11
+        info = rstate.get_actor(actor._actor_id.hex())
+        assert info["state"] == "ALIVE"
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+
+
+class TestPreemptionSoak:
+    def test_preemption_under_load_smoke(self):
+        """Tier-1 variant (<60s): small mixed load, one preemption."""
+        _preemption_soak(n_tasks=40, n_actor_calls=10, deadline_s=6.0)
+
+    @pytest.mark.stress
+    @pytest.mark.slow
+    def test_preemption_under_load_soak(self):
+        """Full soak: heavier load, longer drain window."""
+        _preemption_soak(n_tasks=200, n_actor_calls=60, deadline_s=12.0,
+                         task_sleep_s=0.1)
 
 
 class TestOomWorkerKilling:
