@@ -1,0 +1,34 @@
+# Developer entry points. `make lint` is the one-command gate PR
+# builders run locally; tier-1 runs the same check as a test
+# (tests/test_raycheck.py::TestLiveTree).
+
+PYTHON ?= python3
+
+.PHONY: lint test build asan clean
+
+lint:
+	$(PYTHON) -m tools.raycheck ray_tpu/ tests/
+
+test:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow'
+
+build:
+	$(MAKE) -C src/fastpath PYTHON=$(PYTHON)
+	$(MAKE) -C src/object_store
+
+# instrumented native extensions, built into separate _build_asan dirs —
+# NEVER into the production _build, where an ASan .so (unloadable without
+# LD_PRELOAD) would silently force the Python fallback on every later run.
+# See README "Static analysis & sanitizers" for the LD_PRELOAD recipe.
+ASAN_FASTPATH_DIR := $(CURDIR)/ray_tpu/_private/fastpath/_build_asan
+ASAN_STORE_DIR := $(CURDIR)/ray_tpu/_private/object_store/_build_asan
+
+asan:
+	$(MAKE) -C src/fastpath SANITIZE=asan PYTHON=$(PYTHON) BUILD_DIR=$(ASAN_FASTPATH_DIR)
+	$(MAKE) -C src/object_store SANITIZE=asan BUILD_DIR=$(ASAN_STORE_DIR)
+	@echo "ASan fastpath: run with RAY_TPU_FASTPATH_BUILD_DIR=$(ASAN_FASTPATH_DIR)"
+
+clean:
+	$(MAKE) -C src/fastpath clean
+	$(MAKE) -C src/object_store clean
+	rm -rf $(ASAN_FASTPATH_DIR) $(ASAN_STORE_DIR)

@@ -19,6 +19,11 @@ from typing import List, Optional
 import psutil
 
 
+# shared default rng for helpers called without an explicit one: seeded
+# (replayable across runs) while still varying across successive calls
+_DEFAULT_RNG = random.Random(0)
+
+
 def rpc_delay_spec(method: str, prob: float, delay_ms: float) -> str:
     """One ``testing_rpc_failure`` entry injecting latency instead of a
     failure (join multiple with commas)."""
@@ -50,7 +55,10 @@ def list_worker_pids(raylet_pid: int) -> List[int]:
 def kill_random_worker(cluster, rng: Optional[random.Random] = None) -> Optional[int]:
     """SIGKILL one random worker process somewhere in the cluster;
     returns its pid (None if no workers are running)."""
-    rng = rng or random.Random()
+    # default is SEEDED but shared: replayable across runs, yet
+    # successive no-rng calls still draw a fresh value each time (a
+    # per-call Random(0) would kill the same list position forever)
+    rng = rng or _DEFAULT_RNG
     pids: List[int] = []
     for node in cluster.nodes:
         pids.extend(list_worker_pids(node.proc.pid))

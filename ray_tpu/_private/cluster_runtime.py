@@ -89,8 +89,8 @@ class ClusterRuntime(CoreWorker):
     def shutdown(self) -> None:
         try:
             self.gcs.call("MarkJobFinished", job_id=self.job_id.hex(), timeout=5)
-        except Exception:
-            pass
+        except Exception:  # GCS may already be gone — finish local teardown
+            logger.debug("MarkJobFinished failed at shutdown", exc_info=True)
         super().shutdown()
         clear_client_cache()
         if self._node is not None:

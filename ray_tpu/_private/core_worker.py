@@ -28,6 +28,7 @@ import uuid
 from concurrent.futures import Future
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from ray_tpu._private import debug_locks
 from ray_tpu._private import worker as worker_mod
 from ray_tpu._private.config import config
 from ray_tpu._private.core import ActorOptions, CoreRuntime, TaskOptions
@@ -612,13 +613,16 @@ class CoreWorker(CoreRuntime):
         # (owner-based location directory: the owner's memory-store entry
         # names the node; this maps it to that node's object manager)
         self._node_addrs: Dict[str, Tuple[str, int]] = {}
-        self._node_addrs_lock = threading.Lock()
+        self._node_addrs_lock = debug_locks.maybe_wrap(
+            threading.Lock(), "core_worker.CoreWorker._node_addrs_lock")
 
         # owner RPC server (GetObject / WaitObject / health). Handlers
         # that only touch the memory store / pending tables register
         # inline: they run on the io loop with no executor handoff —
         # the result-delivery hop of every warm actor call rides these.
         self.server = RpcServer(name=f"core-{self.worker_id_hex[:8]}")
+        # single-item endpoint kept for debugging/compat (the runtime
+        # itself uses the batched GetObjectsStatus) — raycheck: disable=RC003
         self.server.register("GetObject", self._handle_get_object,
                              inline=True)
         self.server.register("GetObjectsStatus",
@@ -629,6 +633,7 @@ class CoreWorker(CoreRuntime):
                              inline=True)
         self.server.register("RemoveBorrower", self._handle_remove_borrower,
                              inline=True)
+        # single-item fallback of ActorTasksDone — raycheck: disable=RC003
         self.server.register("ActorTaskDone", self._handle_actor_task_done,
                              inline=True)
         self.server.register("ActorTasksDone", self._handle_actor_tasks_done,
@@ -649,7 +654,8 @@ class CoreWorker(CoreRuntime):
         self._spread_rr = -1
 
         # task submission state
-        self._lock = threading.Lock()
+        self._lock = debug_locks.maybe_wrap(
+            threading.Lock(), "core_worker.CoreWorker._lock")
         self._leases: Dict[Any, List[_LeaseEntry]] = {}  # scheduling_class -> entries
         self._lease_requests_inflight: Dict[Any, int] = {}
         # keep-alive sweeper for idle granted leases (io-loop task,
@@ -679,7 +685,8 @@ class CoreWorker(CoreRuntime):
         self._actor_disp_lock = threading.Lock()
         self._pending_actor_tasks: Dict[TaskID, Dict[str, Any]] = {}
         self._actor_task_contained: Dict[TaskID, List[ObjectID]] = {}
-        self._actor_pending_lock = threading.Lock()
+        self._actor_pending_lock = debug_locks.maybe_wrap(
+            threading.Lock(), "core_worker.CoreWorker._actor_pending_lock")
 
         # blocked-in-get tracking (CPU release protocol, see get())
         self._blocked_depth = 0
@@ -697,7 +704,8 @@ class CoreWorker(CoreRuntime):
         # (handed-off borrows; interest released at outer-ref release —
         # advisor finding, round 1: unclaimed handoffs pinned forever)
         self._handoff_borrows: Dict[ObjectID, List[Tuple[ObjectID, Tuple[str, int]]]] = {}
-        self._borrow_lock = threading.Lock()
+        self._borrow_lock = debug_locks.maybe_wrap(
+            threading.Lock(), "core_worker.CoreWorker._borrow_lock")
         from concurrent.futures import ThreadPoolExecutor as _TPE
 
         self._borrow_release_pool = _TPE(max_workers=1, thread_name_prefix="borrow-release")
@@ -3042,7 +3050,7 @@ class CoreWorker(CoreRuntime):
         try:
             self.plasma.close()
         except Exception:
-            pass
+            logger.debug("plasma close failed at shutdown", exc_info=True)
         # close every RPC client this process opened: each one owns a
         # read-loop task that must be cancelled AND awaited, or asyncio
         # logs "Task was destroyed but it is pending!" at exit

@@ -40,7 +40,11 @@ from ray_tpu._private.fastpath import _pyimpl
 logger = logging.getLogger(__name__)
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_BUILD_DIR = os.path.join(_DIR, "_build")
+# RAY_TPU_FASTPATH_BUILD_DIR: alternate build/load directory — the ASan
+# test builds an instrumented .so into a temp dir and points a child
+# interpreter here, without clobbering the normal build
+_BUILD_DIR = os.environ.get("RAY_TPU_FASTPATH_BUILD_DIR") or \
+    os.path.join(_DIR, "_build")
 # ABI-tagged filename + built with THIS interpreter's headers: a 3.10
 # venv and a 3.13 system python keep separate extensions — loading a
 # mismatched ABI would be undefined behavior, not an ImportError
@@ -80,7 +84,8 @@ def _build_locked() -> bool:
                 if _needs_build(src):  # re-check: the lock winner built it
                     subprocess.run(
                         ["make", "-C", src_dir,
-                         f"PYTHON={sys.executable}"],
+                         f"PYTHON={sys.executable}",
+                         f"BUILD_DIR={_BUILD_DIR}"],
                         check=True, capture_output=True, timeout=120,
                     )
             finally:

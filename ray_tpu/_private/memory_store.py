@@ -12,6 +12,7 @@ import threading
 from concurrent.futures import Future
 from typing import Any, Dict, List, Optional
 
+from ray_tpu._private import debug_locks
 from ray_tpu._private.ids import ObjectID
 from ray_tpu.exceptions import GetTimeoutError
 
@@ -26,7 +27,8 @@ class _Entry:
 
 class MemoryStore:
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = debug_locks.maybe_wrap(
+            threading.Lock(), "memory_store.MemoryStore._lock")
         self._objects: Dict[ObjectID, _Entry] = {}
         self._waiters: Dict[ObjectID, List[Future]] = {}
 

@@ -66,7 +66,7 @@ class ObjectRef:
             if w is not None and w.connected:
                 w.reference_counter.remove_local_reference(self._id)
         except Exception:
-            pass
+            pass  # __del__ during interpreter teardown: modules half-gone
 
     # -- pickling: refs travel with owner metadata ------------------------
     def __reduce__(self):

@@ -32,6 +32,7 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from ray_tpu._private import debug_locks
 from ray_tpu._private.config import config
 from ray_tpu._private.ids import NodeID
 from ray_tpu._private.rpc import LoopHandle, RpcClient, RpcServer
@@ -160,7 +161,8 @@ class Zygote:
     paying a cold interpreter + import chain per worker."""
 
     def __init__(self, env: Dict[str, str], session_dir: str):
-        self._lock = threading.Lock()
+        self._lock = debug_locks.maybe_wrap(
+            threading.Lock(), "raylet.Zygote._lock")
         self._log = open(os.path.join(session_dir, "zygote.log"), "ab")
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.workers.zygote"],

@@ -17,6 +17,8 @@ import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Optional, Set, Tuple
 
+from ray_tpu._private import debug_locks
+
 from ray_tpu._private.ids import ObjectID
 
 
@@ -39,7 +41,8 @@ class _Ref:
 
 class ReferenceCounter:
     def __init__(self) -> None:
-        self._lock = threading.RLock()
+        self._lock = debug_locks.maybe_wrap(
+            threading.RLock(), "reference_counter.ReferenceCounter._lock")
         self._refs: Dict[ObjectID, _Ref] = {}
         # called when an *owned* object's global count hits zero
         self._on_zero: Optional[Callable[[ObjectID], None]] = None

@@ -38,7 +38,7 @@ import threading
 import time
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
-from ray_tpu._private import fastpath
+from ray_tpu._private import debug_locks, fastpath
 from ray_tpu._private.config import config
 
 logger = logging.getLogger(__name__)
@@ -322,6 +322,11 @@ class EventLoopThread:
 
     def stop(self) -> None:
         self.loop.call_soon_threadsafe(self.loop.stop)
+        # reap the loop thread (bounded: run_forever returns right after
+        # the stop above is processed); self-stop from a loop callback
+        # must not join itself
+        if threading.current_thread() is not self._thread:
+            self._thread.join(timeout=5)
 
 
 class LoopHandle:
@@ -419,7 +424,10 @@ class RpcServer:
             try:
                 self._loop_thread.run_coro(_close(), timeout=5)
             except Exception:
-                pass
+                # the owning loop may already be gone at teardown; the
+                # socket dies with the process either way
+                logger.debug("%s: server close failed", self.name,
+                             exc_info=True)
 
     # -- serving ----------------------------------------------------------
     async def _on_client(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
@@ -716,11 +724,18 @@ class RpcClient:
         try:
             self._loop_thread.run_coro(_close(), timeout=5)
         except Exception:
-            pass
+            # owning loop already stopped at teardown: in-flight futures
+            # were failed by the read loop's finally; nothing left to free
+            logger.debug("client close to %s:%s failed", self.host,
+                         self.port, exc_info=True)
 
 
 _client_cache: Dict[Tuple[str, int], RpcClient] = {}
-_client_cache_lock = threading.Lock()
+# RAY_TPU_DEBUG_LOCKS=1 wraps this (and the other central _private locks)
+# in an order-recording proxy that raises on cycle-forming acquisition —
+# the dynamic validation of raycheck's static RC002 lock-order model
+_client_cache_lock = debug_locks.maybe_wrap(
+    threading.Lock(), "rpc._client_cache_lock")
 
 
 def get_client(addr: Tuple[str, int]) -> RpcClient:

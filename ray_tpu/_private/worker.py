@@ -63,6 +63,12 @@ class Worker:
 
 global_worker: Optional[Worker] = None
 _init_lock = threading.Lock()
+# set while no teardown is in flight: shutdown() clears it before the
+# slow lock-free teardown and sets it when done, so a concurrent init()
+# waits for the old runtime's client-cache sweep instead of having its
+# fresh RPC clients closed out from under it
+_teardown_done = threading.Event()
+_teardown_done.set()
 
 
 def _require_connected() -> Worker:
@@ -99,6 +105,16 @@ def init(
     """
     global global_worker
     with _init_lock:
+        # serialize against an in-flight shutdown() teardown (which runs
+        # outside _init_lock — see shutdown's RC002 note). Waiting UNDER
+        # the lock is deadlock-free (the event's setter never takes the
+        # lock) and closes the check-then-act gap a pre-lock wait would
+        # leave; bounded by the timeout — raycheck: disable=RC002
+        if not _teardown_done.wait(timeout=60):
+            logger.warning(
+                "previous runtime teardown still in flight after 60s; "
+                "proceeding with init (old client-cache sweep may race "
+                "this session's fresh connections)")
         if global_worker is not None and global_worker.connected:
             if ignore_reinit_error:
                 return {"already_initialized": True}
@@ -156,22 +172,37 @@ def _atexit_shutdown() -> None:
     try:
         shutdown()
     except Exception:
-        pass
+        logger.debug("atexit shutdown failed", exc_info=True)
 
 
 def shutdown() -> None:
     global global_worker
+    # RC002: detach inside the lock, tear down outside it. core.shutdown()
+    # closes RPC clients and parks in run_coro — holding _init_lock across
+    # that is the PR-7 livelock shape (any thread entering init/shutdown
+    # meanwhile would wedge behind a multi-second teardown). A concurrent
+    # init() is serialized by the _teardown_done event instead of the lock.
     with _init_lock:
-        if global_worker is None:
-            return
         w = global_worker
-        global_worker = None
+        if w is not None:
+            global_worker = None
+            _teardown_done.clear()
+    if w is None:
+        # a concurrent shutdown() may still be mid-teardown: keep this
+        # function's completed-on-return contract (atexit relies on it —
+        # returning early would let the interpreter die under the other
+        # thread's run_coro client sweep)
+        _teardown_done.wait(timeout=60)
+        return
+    try:
         if w.core is not None:
             w.reference_counter.freeze()
             try:
                 w.core.shutdown()
             except Exception:
                 logger.exception("Error during shutdown")
+    finally:
+        _teardown_done.set()
 
 
 def is_initialized() -> bool:

@@ -582,6 +582,7 @@ class WorkerServer:
         # enqueue-and-ack handlers only append to the runner's pool queue:
         # inline (no executor handoff) — the ack is on the wire the same
         # loop tick the push frame decodes
+        # single-item fallback of PushActorTasks — raycheck: disable=RC003
         core.server.register("PushActorTask", self.PushActorTask,
                              inline=True)
         core.server.register("PushActorTasks", self.PushActorTasks,
@@ -591,6 +592,8 @@ class WorkerServer:
         core.server.register("KillActor", self.KillActor)
         core.server.register("DrainActor", self.DrainActor)
         core.server.register("SetLeaseContext", self.SetLeaseContext)
+        # operator/debug endpoint: ask a worker to exit gracefully out of
+        # band (the raylet path signals instead) — raycheck: disable=RC003
         core.server.register("Exit", self.Exit)
 
     # -- lease context: assign TPU chips before user code runs ----------

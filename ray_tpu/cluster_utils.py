@@ -213,7 +213,7 @@ class Cluster:
                         n.proc.poll() is None for n in self.nodes):
                     time.sleep(0.05)
             except Exception:
-                pass
+                pass  # best-effort quiesce; kill_process_tree is the backstop
             finally:
                 client.close()
         for node in list(self.nodes):

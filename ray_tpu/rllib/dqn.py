@@ -184,7 +184,7 @@ class DQN:
             try:
                 ray_tpu.kill(r)
             except Exception:
-                pass
+                pass  # runner already dead — kill is best-effort
 
     def save(self, path: str) -> None:
         from ray_tpu.train.checkpoint import save_state

@@ -242,13 +242,13 @@ class IMPALA:
             try:
                 ray_tpu.cancel(fut)
             except Exception:
-                pass
+                pass  # sample already completed — nothing to cancel
         self._inflight.clear()
         for r in self.runners:
             try:
                 ray_tpu.kill(r)
             except Exception:
-                pass
+                pass  # runner already dead — kill is best-effort
 
     def save(self, path: str) -> None:
         from ray_tpu.train.checkpoint import save_state

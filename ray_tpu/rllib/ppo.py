@@ -304,7 +304,7 @@ class PPO:
             try:
                 ray_tpu.kill(r)
             except Exception:
-                pass
+                pass  # runner already dead — kill is best-effort
 
     # checkpointing (reference: Checkpointable, algorithm.py:208)
     def save(self, path: str) -> None:

@@ -520,7 +520,7 @@ def shutdown() -> None:
         ray_tpu.get(ctl.shutdown.remote(), timeout=60)
         ray_tpu.kill(ctl)
     except Exception:
-        pass
+        pass  # controller already dead/killed — shutdown is idempotent
     _state.controller = None
 
 

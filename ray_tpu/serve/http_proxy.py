@@ -197,6 +197,10 @@ class _AsyncProxy:
 
         try:
             self._loop.call_soon_threadsafe(_close)
+            # run_forever returns right after _close runs; reap the thread
+            # so a stopped proxy leaves nothing behind
+            if threading.current_thread() is not self._thread:
+                self._thread.join(timeout=5)
         except Exception:  # noqa: BLE001
             pass
 

@@ -158,6 +158,9 @@ class _TrialActor:
             self._reports.clear()
         return out
 
+    # the trial thread runs user code that may never observe _stop; joining
+    # here would hang the tuner loop, and the actor process exit reaps the
+    # daemon thread — raycheck: disable=RC005
     def stop(self) -> bool:
         self._stop.set()
         return True

@@ -24,7 +24,63 @@ os.environ.setdefault("RAY_TPU_FAKE_CHIPS", "4")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import threading  # noqa: E402
+import time  # noqa: E402
+
 import pytest  # noqa: E402
+
+# Thread-leak guard allowlist (the dynamic face of raycheck RC005):
+# long-lived runtime pools that legitimately outlive a single test. All
+# are process-lifetime ThreadPoolExecutors (non-daemon by design, reaped
+# by their atexit join) or pytest internals.
+_THREAD_ALLOW_PREFIXES = (
+    "rpc-exec",        # EventLoopThread default executor (global loop)
+    "rpc-io",          # event-loop threads (daemon, listed for clarity)
+    "task",            # local-mode task pool
+    "actor-",          # local-mode / worker actor pools
+    "serve-local",     # serve local-mode pool
+    "borrow-release",  # core worker borrow-release pool
+    "exec",            # worker task pool
+    "ThreadPoolExecutor",  # unnamed stdlib pools (grpc proxy, asyncio)
+    "asyncio_",        # asyncio.to_thread default executor
+    "pytest",          # pytest-timeout et al.
+)
+
+
+def _leaked_threads(before):
+    # compare Thread OBJECTS, not idents — CPython recycles idents after
+    # a thread exits, which would let a leak hide behind a dead thread
+    return [
+        t for t in threading.enumerate()
+        if t.is_alive() and not t.daemon
+        and t not in before
+        and t is not threading.main_thread()
+        and not t.name.startswith(_THREAD_ALLOW_PREFIXES)
+    ]
+
+
+@pytest.fixture(autouse=True)
+def _thread_leak_guard(request):
+    """After each test, no NEW non-daemon thread may survive — the
+    dynamic complement of raycheck's RC005 (a stop() path that skips
+    join, or a Thread whose author never decided its daemon-ness, shows
+    up here as a leak). Allowlisted prefixes cover the known
+    process-lifetime runtime pools; mark a test ``no_thread_guard`` to
+    opt out."""
+    if request.node.get_closest_marker("no_thread_guard"):
+        yield
+        return
+    before = set(threading.enumerate())
+    yield
+    deadline = time.monotonic() + 2.0
+    leaked = _leaked_threads(before)
+    while leaked and time.monotonic() < deadline:
+        time.sleep(0.05)  # teardown stragglers get a short grace window
+        leaked = _leaked_threads(before)
+    assert not leaked, (
+        f"test leaked non-daemon thread(s): {[t.name for t in leaked]} — "
+        f"join them in teardown, make them daemon, or (for a known "
+        f"runtime pool) extend _THREAD_ALLOW_PREFIXES in conftest.py")
 
 
 def pytest_addoption(parser):
@@ -41,6 +97,9 @@ def pytest_configure(config):
         "markers", "stress: race-prone suite, repeated --stress-repeat "
                    "times by the repeat-runner")
     config.addinivalue_line("markers", "slow: excluded from tier-1 runs")
+    config.addinivalue_line(
+        "markers", "no_thread_guard: opt out of the per-test non-daemon "
+                   "thread-leak assertion")
 
 
 def pytest_generate_tests(metafunc):

@@ -170,7 +170,7 @@ class TestEndToEnd:
             try:
                 ray_tpu.shutdown()
             except Exception:
-                pass
+                pass  # teardown is best-effort: cluster may already be down
             provider.shutdown()
             cluster.shutdown()
 
@@ -362,5 +362,5 @@ class TestGceAutoscalerLoop:
             try:
                 ray_tpu.shutdown()
             except Exception:
-                pass
+                pass  # teardown is best-effort: cluster may already be down
             cluster.shutdown()

@@ -31,7 +31,7 @@ class TestLocal:
             for _ in range(100):
                 got.append(r.read(timeout=30))
 
-        t = threading.Thread(target=consume)
+        t = threading.Thread(target=consume, daemon=True)
         t.start()
         for i in range(100):
             ch.write(i, timeout=30)
@@ -71,8 +71,8 @@ class TestLocal:
             for _ in range(20):
                 out.append(r.read(timeout=30))
 
-        t0 = threading.Thread(target=consume, args=(r0, got0))
-        t1 = threading.Thread(target=consume, args=(r1, got1))
+        t0 = threading.Thread(target=consume, args=(r0, got0), daemon=True)
+        t1 = threading.Thread(target=consume, args=(r1, got1), daemon=True)
         t0.start(); t1.start()
         for i in range(20):
             ch.write(i, timeout=30)

@@ -21,7 +21,7 @@ def chaos_cluster():
     try:
         ray_tpu.shutdown()
     except Exception:
-        pass
+        pass  # teardown is best-effort: chaos may have killed the cluster
 
 
 def _run_workload(n=30, retries=3):
@@ -153,7 +153,7 @@ class TestProcessChaos:
             try:
                 ray_tpu.shutdown()
             except Exception:
-                pass
+                pass  # teardown is best-effort: chaos may have killed the cluster
             cluster.shutdown()
 
     def test_workload_survives_node_kill(self):
@@ -185,7 +185,7 @@ class TestProcessChaos:
             try:
                 ray_tpu.shutdown()
             except Exception:
-                pass
+                pass  # teardown is best-effort: chaos may have killed the cluster
             cluster.shutdown()
 
 
@@ -278,7 +278,7 @@ def _preemption_soak(n_tasks: int, n_actor_calls: int, deadline_s: float,
         try:
             ray_tpu.shutdown()
         except Exception:
-            pass
+            pass  # teardown is best-effort: chaos may have killed the cluster
         cluster.shutdown()
 
 
@@ -356,7 +356,7 @@ class TestOomWorkerKilling:
             try:
                 ray_tpu.shutdown()
             except Exception:
-                pass
+                pass  # teardown is best-effort: chaos may have killed the cluster
             cluster.shutdown()
 
 

@@ -16,7 +16,7 @@ def test_streaming_bounded_memory_small_store():
     try:
         ray_tpu.shutdown()
     except Exception:
-        pass
+        pass  # teardown is best-effort: no prior cluster in most runs
     cap = 64 * 1024 * 1024
     ray_tpu.init(num_cpus=4, object_store_memory=cap,
                  ignore_reinit_error=True)

@@ -108,7 +108,7 @@ def _run_ranks(world, fn):
         except BaseException as e:  # noqa: BLE001
             errs[r] = e
 
-    ts = [threading.Thread(target=run, args=(r,)) for r in range(world)]
+    ts = [threading.Thread(target=run, args=(r,), daemon=True) for r in range(world)]
     for t in ts:
         t.start()
     for t in ts:
@@ -312,7 +312,7 @@ def test_rpc_teardown_fails_inflight_futures():
         except Exception as e:  # noqa: BLE001
             errs.append(e)
 
-    t = threading.Thread(target=call)
+    t = threading.Thread(target=call, daemon=True)
     t.start()
     deadline = time.monotonic() + 5
     while not client._pending and time.monotonic() < deadline:

@@ -34,7 +34,7 @@ def two_node_cluster():
     try:
         ray_tpu.shutdown()
     except Exception:
-        pass
+        pass  # teardown is best-effort: node may already be drained away
     cluster.shutdown()
 
 
@@ -236,7 +236,7 @@ class TestGracefulDrain:
                 except Exception as e:  # noqa: BLE001
                     errors.append(repr(e))
 
-        t = threading.Thread(target=load)
+        t = threading.Thread(target=load, daemon=True)
         t.start()
         try:
             time.sleep(1.5)  # leases warm on both nodes

@@ -147,7 +147,7 @@ class TestServeContinuous:
             threads = []
             for i, text in enumerate(["hello", "hi", "a longer prompt",
                                       "x", "mid size"]):
-                th = threading.Thread(target=call, args=(i, text))
+                th = threading.Thread(target=call, args=(i, text), daemon=True)
                 th.start()
                 threads.append(th)
                 time.sleep(0.15)  # staggered arrivals

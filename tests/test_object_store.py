@@ -83,7 +83,7 @@ def test_get_blocks_until_seal(store):
 
     # separate connection for the blocking get
     store2 = StoreClient(store._sock.getpeername())
-    t = threading.Thread(target=getter)
+    t = threading.Thread(target=getter, daemon=True)
     t.start()
     time.sleep(0.1)
     buf = store.create(oid, 5)

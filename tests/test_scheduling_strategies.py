@@ -28,7 +28,7 @@ def two_node_cluster():
     try:
         ray_tpu.shutdown()
     except Exception:
-        pass
+        pass  # teardown is best-effort: cluster may already be down
     cluster.shutdown()
 
 
@@ -133,7 +133,7 @@ class TestHardConstraintSizing:
         try:
             ray_tpu.shutdown()
         except Exception:
-            pass
+            pass  # teardown is best-effort: fresh-state guard
         cluster = Cluster()
         cluster.add_node(num_cpus=1, labels={"pool": "a"})
         big = cluster.add_node(num_cpus=4, labels={"pool": "a"})

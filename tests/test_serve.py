@@ -101,7 +101,7 @@ class TestBatching:
         h = serve.run(Model.bind())
         results = []
         threads = [
-            threading.Thread(target=lambda i=i: results.append(h.predict.remote(i).result()))
+            threading.Thread(target=lambda i=i: results.append(h.predict.remote(i).result()), daemon=True)
             for i in range(8)
         ]
         for t in threads:
@@ -121,7 +121,7 @@ class TestBatching:
 
         outs = []
         threads = [
-            threading.Thread(target=lambda i=i: outs.append(predict(i))) for i in range(4)
+            threading.Thread(target=lambda i=i: outs.append(predict(i)), daemon=True) for i in range(4)
         ]
         for t in threads:
             t.start()
@@ -224,7 +224,7 @@ class TestReplicaSideRejection:
         threads = []
         for i in range(8):
             for h in (h1, h2):
-                t = threading.Thread(target=_fire, args=(h, i))
+                t = threading.Thread(target=_fire, args=(h, i), daemon=True)
                 t.start()
                 threads.append(t)
         for t in threads:

@@ -19,7 +19,7 @@ def serve_cluster(ray_start_regular):
     try:
         serve.shutdown()
     except Exception:
-        pass
+        pass  # teardown is best-effort: serve may not be started
 
 
 def _mux_model(num_replicas: int, name: str):

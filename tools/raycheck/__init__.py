@@ -1,0 +1,36 @@
+"""raycheck — distributed-runtime static analysis for ray_tpu.
+
+Run as ``python -m tools.raycheck ray_tpu/ tests/`` (or ``make lint``).
+Rules target the bug classes this codebase has actually shipped fixes
+for: event-loop blocking (RC001), lock-order/livelock shapes (RC002),
+RPC method-name contract drift (RC003), non-determinism in seeded chaos
+paths (RC004), and thread lifecycle hygiene (RC005). See
+tools/raycheck/README.md for each rule with real before/after examples.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from tools.raycheck import baseline as baseline_mod
+from tools.raycheck.rules import (  # noqa: F401 — public API
+    Finding,
+    RULE_DOCS,
+    SourceModule,
+    analyze,
+    load_modules,
+)
+
+
+def run(paths: List[str], baseline_path: Optional[str] = None,
+        rules: Optional[List[str]] = None, root: Optional[str] = None,
+        ) -> Tuple[List[Finding], List[Finding], List[str]]:
+    """Programmatic entry point (tests use this).
+
+    Returns (new_findings, grandfathered_findings, stale_fingerprints).
+    Exit-status contract: non-empty ``new_findings`` means fail.
+    """
+    modules = load_modules(paths, root=root)
+    findings = analyze(modules, rules=rules)
+    base = baseline_mod.load(baseline_path) if baseline_path else {}
+    return baseline_mod.apply(findings, base)

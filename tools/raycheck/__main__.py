@@ -1,0 +1,83 @@
+"""CLI: ``python -m tools.raycheck [paths...]``.
+
+Exit codes: 0 = clean (every finding suppressed or baselined),
+1 = new findings, 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from collections import Counter
+from typing import List
+
+from tools.raycheck import baseline as baseline_mod
+from tools.raycheck.rules import RULE_DOCS, analyze, load_modules
+
+
+def main(argv: List[str] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.raycheck",
+        description="ray_tpu distributed-runtime static analysis")
+    ap.add_argument("paths", nargs="*", default=["ray_tpu/", "tests/"],
+                    help="files/directories to scan (default: ray_tpu/ "
+                         "tests/)")
+    ap.add_argument("--rules", metavar="RC001,RC002,...",
+                    help="comma list of rule ids to run (default: all)")
+    ap.add_argument("--baseline", default=baseline_mod.DEFAULT_BASELINE,
+                    help="baseline JSON path")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report everything")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="grandfather every current finding and exit 0")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="print only the summary line")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, doc in sorted(RULE_DOCS.items()):
+            print(f"{rid}  {doc}")
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rules if r not in RULE_DOCS]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)}", file=sys.stderr)
+            return 2
+
+    paths = args.paths or ["ray_tpu/", "tests/"]
+    modules = load_modules(paths)
+    if not modules:
+        print(f"no python files under: {' '.join(paths)}", file=sys.stderr)
+        return 2
+    findings = analyze(modules, rules=rules)
+
+    if args.write_baseline:
+        baseline_mod.save(args.baseline, findings)
+        print(f"raycheck: baseline written: {len(findings)} finding(s) "
+              f"grandfathered -> {args.baseline}")
+        return 0
+
+    base = Counter() if args.no_baseline else baseline_mod.load(args.baseline)
+    new, old, stale = baseline_mod.apply(findings, base)
+
+    if not args.quiet:
+        for f in new:
+            print(f.render())
+        for fp in stale:
+            print(f"stale baseline entry (fixed? regenerate the baseline): "
+                  f"{fp}")
+    per_rule = Counter(f.rule for f in new)
+    detail = ", ".join(f"{r}:{n}" for r, n in sorted(per_rule.items()))
+    print(f"raycheck: {len(modules)} files, {len(new)} new finding(s)"
+          + (f" ({detail})" if detail else "")
+          + (f", {len(old)} baselined" if old else "")
+          + (f", {len(stale)} stale baseline entr(y/ies)" if stale else ""))
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,68 @@
+"""Baseline: grandfathered findings checked in as JSON.
+
+A baseline entry is a finding fingerprint (rule|path|scope|detail) plus
+a count — line numbers are deliberately absent so unrelated edits to the
+same file do not churn the baseline. ``--write-baseline`` regenerates
+the file from the current tree; a finding "covered" by the baseline is
+hidden (up to its recorded count), and baseline entries that no longer
+match anything are reported as stale so the file shrinks over time
+instead of fossilizing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter
+from typing import Dict, List, Tuple
+
+from tools.raycheck.rules import Finding
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def load(path: str) -> Counter:
+    if not os.path.exists(path):
+        return Counter()
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    out: Counter = Counter()
+    for entry in data.get("findings", []):
+        out[entry["fingerprint"]] += int(entry.get("count", 1))
+    return out
+
+
+def save(path: str, findings: List[Finding]) -> None:
+    counts: Counter = Counter(f.fingerprint for f in findings)
+    messages: Dict[str, str] = {}
+    for f in findings:
+        messages.setdefault(f.fingerprint, f.message)
+    data = {
+        "comment": "raycheck grandfathered findings — regenerate with "
+                   "`python -m tools.raycheck --write-baseline`; shrink "
+                   "this file by fixing findings, never grow it without "
+                   "a review",
+        "findings": [
+            {"fingerprint": fp, "count": n, "message": messages[fp]}
+            for fp, n in sorted(counts.items())
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=2)
+        fh.write("\n")
+
+
+def apply(findings: List[Finding], baseline: Counter
+          ) -> Tuple[List[Finding], List[Finding], List[str]]:
+    """(new, grandfathered, stale_fingerprints)."""
+    budget = Counter(baseline)
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        if budget.get(f.fingerprint, 0) > 0:
+            budget[f.fingerprint] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    stale = sorted(fp for fp, n in budget.items() if n > 0)
+    return new, old, stale

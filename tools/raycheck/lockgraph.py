@@ -1,0 +1,230 @@
+"""RC002 — lock-order: static lock-acquisition graph over _private/.
+
+Lock identities:
+  * module-level:  ``X = threading.Lock()``            -> mod.X
+  * class-level:   ``X = threading.Lock()`` in a class -> mod.Class.X
+  * instance:      ``self.X = threading.Lock()``       -> mod.Class.X
+
+Acquisition sites are ``with L:`` / ``with L1, L2:`` blocks and bare
+``L.acquire()`` calls. Nesting one acquisition inside another records a
+directed edge outer->inner; a cycle in the resulting graph is a
+potential deadlock and is reported once per cycle.
+
+The PR-7 livelock was not a lock *cycle* but a lock held across a call
+into another module's blocking machinery (clear_client_cache closed RPC
+clients while holding the lock the io loop needed inside get_client).
+That shape is flagged directly: while a module-level (or class-level)
+lock is held, calls whose terminal method is known-blocking
+(close/join/wait/run_coro/result/call/call_retrying/stop/shutdown/
+connect/sleep) are findings — do the slow work after dropping the lock.
+
+The static model is validated dynamically by the RAY_TPU_DEBUG_LOCKS=1
+proxy in ray_tpu/_private/debug_locks.py, which records real
+acquisition orders and raises on a cycle-forming acquisition.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.raycheck.rules import Finding, SourceModule, terminal_attr
+
+_HOLD_CALL_DENY = {
+    "close", "join", "wait", "run_coro", "result", "call", "call_retrying",
+    "call_oneway", "acall", "stop", "shutdown", "connect", "sleep",
+}
+
+
+def _in_scope(mod: SourceModule) -> bool:
+    return "_private/" in mod.relpath or \
+        os.sep + "_private" + os.sep in mod.relpath
+
+
+def _is_lock_ctor(mod: SourceModule, node: ast.expr) -> bool:
+    """threading.Lock()/RLock()/Condition(), possibly wrapped in a call
+    like debug_locks.maybe_wrap(threading.Lock(), "name")."""
+    if isinstance(node, ast.Call):
+        fn = node.func
+        for attr in ("Lock", "RLock", "Condition"):
+            if mod.resolves_to(fn, "threading", attr):
+                return True
+        return any(_is_lock_ctor(mod, a) for a in node.args)
+    return False
+
+
+def _collect_locks(mod: SourceModule) -> Tuple[Dict[str, str], Dict[str, str]]:
+    """(module_locks: name -> id, instance_locks: attr -> id)."""
+    module_locks: Dict[str, str] = {}
+    instance_locks: Dict[str, str] = {}
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and _is_lock_ctor(mod, node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    module_locks[tgt.id] = f"{mod.modname}.{tgt.id}"
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, ast.Assign) and \
+                        _is_lock_ctor(mod, item.value):
+                    for tgt in item.targets:
+                        if isinstance(tgt, ast.Name):
+                            # class-level lock: shared like a module lock
+                            module_locks[tgt.id] = \
+                                f"{mod.modname}.{node.name}.{tgt.id}"
+        if isinstance(node, ast.Assign) and _is_lock_ctor(mod, node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id == "self":
+                    cls = mod.scope_of(node).split(".")[0]
+                    instance_locks[tgt.attr] = \
+                        f"{mod.modname}.{cls}.{tgt.attr}"
+    return module_locks, instance_locks
+
+
+def _lock_id(mod: SourceModule, module_locks: Dict[str, str],
+             instance_locks: Dict[str, str],
+             expr: ast.expr) -> Optional[Tuple[str, bool]]:
+    """(lock id, is_shared) for an expression naming a known lock."""
+    if isinstance(expr, ast.Name) and expr.id in module_locks:
+        return module_locks[expr.id], True
+    if isinstance(expr, ast.Attribute):
+        if isinstance(expr.value, ast.Name) and \
+                expr.value.id in ("self", "cls"):
+            if expr.attr in instance_locks:
+                return instance_locks[expr.attr], False
+            if expr.attr in module_locks:  # cls._singleton_lock
+                return module_locks[expr.attr], True
+        elif expr.attr in module_locks:  # othermod.X — name match only
+            return module_locks[expr.attr], True
+    return None
+
+
+class _HeldWalker(ast.NodeVisitor):
+    """Walk one function tracking which known locks are held."""
+
+    def __init__(self, mod: SourceModule, module_locks, instance_locks,
+                 edges, edge_sites, hold_findings):
+        self.mod = mod
+        self.module_locks = module_locks
+        self.instance_locks = instance_locks
+        self.edges: Dict[str, Set[str]] = edges
+        self.edge_sites: Dict[Tuple[str, str], Tuple[str, int]] = edge_sites
+        self.hold_findings: List[Finding] = hold_findings
+        self.held: List[Tuple[str, bool]] = []  # (lock id, is_shared)
+
+    def visit_FunctionDef(self, node):  # noqa: N802 — nested defs run later
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):  # noqa: N802
+        pass
+
+    def _enter(self, lock: Tuple[str, bool], site_line: int) -> None:
+        lid, _shared = lock
+        for held_id, _ in self.held:
+            if held_id == lid:
+                continue  # re-entrant RLock nesting: not an order edge
+                # (matches debug_locks.before_acquire's dynamic model)
+            self.edges.setdefault(held_id, set()).add(lid)
+            self.edge_sites.setdefault((held_id, lid),
+                                       (self.mod.relpath, site_line))
+        self.held.append(lock)
+
+    def visit_With(self, node):  # noqa: N802
+        entered = 0
+        for item in node.items:
+            lock = _lock_id(self.mod, self.module_locks,
+                            self.instance_locks, item.context_expr)
+            if lock is not None:
+                self._enter(lock, node.lineno)
+                entered += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(entered):
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_Call(self, node):  # noqa: N802
+        attr = terminal_attr(node.func)
+        if attr in ("acquire", "release") and \
+                isinstance(node.func, ast.Attribute):
+            lock = _lock_id(self.mod, self.module_locks,
+                            self.instance_locks, node.func.value)
+            if lock is not None:
+                if attr == "acquire":
+                    # bare acquire(): held from here until a matching
+                    # release() (or end of function) — the with-less
+                    # spelling of lock-holding must not evade the rule
+                    self._enter(lock, node.lineno)
+                else:
+                    for i in range(len(self.held) - 1, -1, -1):
+                        if self.held[i][0] == lock[0]:
+                            del self.held[i]
+                            break
+        if attr in _HOLD_CALL_DENY and isinstance(node.func, ast.Attribute):
+            shared_held = [lid for lid, shared in self.held if shared]
+            if shared_held:
+                self.hold_findings.append(Finding(
+                    "RC002", self.mod.relpath, node.lineno,
+                    self.mod.scope_of(node),
+                    f".{attr}() called while holding module-level lock "
+                    f"{shared_held[-1]} — the PR-7 livelock shape: drop "
+                    f"the lock (snapshot state inside, act outside) "
+                    f"before blocking/teardown calls",
+                    f"hold-call:{attr}"))
+        self.generic_visit(node)
+
+
+def _find_cycles(edges: Dict[str, Set[str]]) -> List[List[str]]:
+    """Elementary cycles, deduped by node set (DFS; graphs here are tiny)."""
+    cycles: List[List[str]] = []
+    seen_sets: Set[frozenset] = set()
+
+    def dfs(start: str, node: str, path: List[str], visited: Set[str]):
+        for nxt in sorted(edges.get(node, ())):
+            if nxt == start:
+                key = frozenset(path)
+                if key not in seen_sets:
+                    seen_sets.add(key)
+                    cycles.append(path[:])
+            elif nxt not in visited and len(path) < 6:
+                visited.add(nxt)
+                dfs(start, nxt, path + [nxt], visited)
+                visited.discard(nxt)
+
+    for n in sorted(edges):
+        dfs(n, n, [n], {n})
+    return cycles
+
+
+def check_rc002(modules: List[SourceModule]) -> List[Finding]:
+    findings: List[Finding] = []
+    edges: Dict[str, Set[str]] = {}
+    edge_sites: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    for mod in modules:
+        if not _in_scope(mod):
+            continue
+        module_locks, instance_locks = _collect_locks(mod)
+        if not module_locks and not instance_locks:
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                w = _HeldWalker(mod, module_locks, instance_locks,
+                                edges, edge_sites, findings)
+                for stmt in node.body:
+                    w.visit(stmt)
+    for cycle in _find_cycles(edges):
+        a, b = cycle[0], cycle[1 % len(cycle)]
+        path, line = edge_sites.get((a, b), ("?", 0))
+        order = " -> ".join(cycle + [cycle[0]])
+        findings.append(Finding(
+            "RC002", path, line, "<lock-graph>",
+            f"lock-order cycle: {order} — two sites acquire these locks "
+            f"in opposite orders; pick one global order",
+            "cycle:" + "+".join(sorted(set(cycle)))))
+    return findings

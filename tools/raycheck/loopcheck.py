@@ -1,0 +1,258 @@
+"""RC001 — loop-blocking: blocking calls on the event loop.
+
+Two populations of code run directly on an asyncio loop in this
+codebase and must never block:
+
+  * ``async def`` bodies (handlers, dispatchers, sweepers), and
+  * sync handlers registered with ``inline=True`` on an RpcServer
+    (rpc.py runs those on the loop to skip the executor handoff — the
+    PR-7 latency contract).
+
+Registration sites are resolved by scanning every ``*.register("Name",
+handler, inline=True)`` call; ``self.X`` / bare-name handlers resolve to
+the function def in the same module and are checked transitively (depth
+3) through same-class/same-module helpers, so a blocking call *reachable
+from* an inline handler is still a finding.
+
+Blocking predicates (the bug classes PR 7 actually hit):
+  time.sleep, subprocess.run/call/check_call/check_output,
+  socket.create_connection / sock.recv/accept/connect,
+  un-timeouted lock.acquire() / queue.get() / fut.result() /
+  ev.wait() / t.join(), loop_thread.run_coro(...), and synchronous
+  RPC ``client.call(...)`` / ``call_retrying(...)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.raycheck.rules import (
+    Finding,
+    SourceModule,
+    call_kwarg,
+    const_str,
+    dotted_name,
+    is_true,
+    receiver_name,
+    terminal_attr,
+)
+
+_SUBPROCESS_BLOCKING = {"run", "call", "check_call", "check_output"}
+_SOCK_BLOCKING_ATTRS = {"recv", "recv_into", "accept", "connect", "sendall"}
+_MAX_DEPTH = 3
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    return call_kwarg(call, "timeout") is not None or \
+        call_kwarg(call, "timeout_s") is not None
+
+
+def blocking_reason(mod: SourceModule, call: ast.Call) -> Optional[Tuple[str, str]]:
+    """(detail, human reason) when this call can block the loop."""
+    fn = call.func
+    attr = terminal_attr(fn)
+    recv = receiver_name(fn)
+    lrecv = (recv or "").lower()
+    if mod.resolves_to(fn, "time", "sleep"):
+        return "time.sleep", "time.sleep() blocks the event loop"
+    if attr in _SUBPROCESS_BLOCKING and \
+            mod.resolves_to(fn, "subprocess", attr):
+        return f"subprocess.{attr}", \
+            f"subprocess.{attr}() is synchronous process IO"
+    if mod.resolves_to(fn, "socket", "create_connection"):
+        return "socket.create_connection", \
+            "socket.create_connection() is sync network IO"
+    if attr in _SOCK_BLOCKING_ATTRS and "sock" in lrecv:
+        return f"sock.{attr}", f"synchronous socket .{attr}()"
+    if attr == "acquire" and not call.args and not _has_timeout(call) and \
+            call_kwarg(call, "blocking") is None and \
+            ("lock" in lrecv or "sem" in lrecv):
+        return "acquire", "un-timeouted Lock.acquire() can park the loop"
+    if attr == "get" and not call.args and not call.keywords and \
+            ("queue" in lrecv or lrecv.endswith("_q")):
+        return "queue.get", "un-timeouted Queue.get() parks the loop"
+    if attr == "result" and not call.args and not _has_timeout(call) and \
+            ("fut" in lrecv or isinstance(fn.value, ast.Call)
+             if isinstance(fn, ast.Attribute) else False):
+        return "future.result", "un-timeouted Future.result() parks the loop"
+    if attr == "run_coro":
+        return "run_coro", ("run_coro() blocks on another loop's result — "
+                            "from loop code use acall/ensure_future")
+    if attr in ("call", "call_retrying") and (
+            "client" in lrecv or lrecv in ("gcs", "raylet", "c", "cli")
+            or (isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Call)
+                and terminal_attr(fn.value.func) == "get_client")):
+        return f"sync-rpc.{attr}", \
+            (f"synchronous RPC .{attr}() from loop code blocks the loop "
+             f"for the full round-trip (use acall or call_oneway)")
+    if attr in ("wait", "join") and not call.args and not _has_timeout(call):
+        return f"{attr}", f"un-timeouted .{attr}() can park the loop forever"
+    return None
+
+
+class _BodyScanner(ast.NodeVisitor):
+    """Collect blocking calls in one function body. Nested defs/lambdas
+    are skipped (they execute elsewhere); a Call directly under Await is
+    exempt (``await x.wait()`` yields, it does not block)."""
+
+    def __init__(self, mod: SourceModule):
+        self.mod = mod
+        self.hits: List[Tuple[ast.Call, str, str]] = []
+        self.calls_made: List[ast.Call] = []
+        self._await_depth = 0
+
+    def scan(self, fn: ast.AST) -> "_BodyScanner":
+        for stmt in fn.body:
+            self.visit(stmt)
+        return self
+
+    def visit_FunctionDef(self, node):  # noqa: N802 — nested def: skip
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):  # noqa: N802
+        pass
+
+    def visit_Await(self, node):  # noqa: N802
+        # inside an await expression, ``ev.wait()`` / ``task.join()`` are
+        # coroutine *constructors* handed to the loop (await ev.wait(),
+        # await asyncio.wait_for(ev.wait(), ...)) — they do not block
+        self._await_depth += 1
+        if isinstance(node.value, ast.Call):
+            # the awaited call itself yields; its arguments still checked
+            for arg in node.value.args:
+                self.visit(arg)
+            for kw in node.value.keywords:
+                self.visit(kw.value)
+        else:
+            self.visit(node.value)
+        self._await_depth -= 1
+
+    def visit_Call(self, node):  # noqa: N802
+        hit = blocking_reason(self.mod, node)
+        if hit is not None and not (
+                self._await_depth > 0 and hit[0] in ("wait", "join")):
+            self.hits.append((node, hit[0], hit[1]))
+        self.calls_made.append(node)
+        self.generic_visit(node)
+
+
+def _function_index(mod: SourceModule) -> Dict[str, ast.AST]:
+    """"func" and "Class.method" -> def node, for transitive resolution."""
+    idx: Dict[str, ast.AST] = {}
+    for node in mod.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            idx[node.name] = node
+        elif isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    idx[f"{node.name}.{item.name}"] = item
+    return idx
+
+
+def _resolve_callee(mod: SourceModule, idx: Dict[str, ast.AST],
+                    scope: str, call: ast.Call) -> Optional[str]:
+    """Resolve a call made inside ``scope`` to a key of ``idx``."""
+    fn = call.func
+    cls = scope.split(".")[0] if "." in scope else None
+    if isinstance(fn, ast.Attribute) and \
+            isinstance(fn.value, ast.Name) and fn.value.id in ("self", "cls"):
+        if cls and f"{cls}.{fn.attr}" in idx:
+            return f"{cls}.{fn.attr}"
+        # self.X where the enclosing class isn't obvious from the scope
+        for key in idx:
+            if key.endswith(f".{fn.attr}"):
+                return key
+        return None
+    if isinstance(fn, ast.Name) and fn.id in idx:
+        return fn.id
+    return None
+
+
+def _inline_handlers(mod: SourceModule) -> List[Tuple[str, ast.expr, int]]:
+    """(method_name, handler_expr, lineno) for inline=True registrations."""
+    out = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and \
+                terminal_attr(node.func) == "register" and \
+                is_true(call_kwarg(node, "inline")):
+            method = const_str(node.args[0]) if node.args else None
+            handler = node.args[1] if len(node.args) > 1 else \
+                call_kwarg(node, "handler")
+            if method and handler is not None:
+                out.append((method, handler, node.lineno))
+    return out
+
+
+def _check_reachable(mod: SourceModule, idx: Dict[str, ast.AST],
+                     start_key: str, origin: str,
+                     findings: List[Finding]) -> None:
+    """DFS from a handler def through same-module helpers, flagging
+    blocking calls with the handler named in the message."""
+    seen: Set[str] = set()
+    stack: List[Tuple[str, int]] = [(start_key, 0)]
+    while stack:
+        key, depth = stack.pop()
+        if key in seen or key not in idx:
+            continue
+        seen.add(key)
+        fn = idx[key]
+        if isinstance(fn, ast.AsyncFunctionDef):
+            continue  # async helpers are covered by the async-def sweep
+        sc = _BodyScanner(mod).scan(fn)
+        via = "" if key == start_key else f" (reached via {key})"
+        for call, detail, reason in sc.hits:
+            findings.append(Finding(
+                "RC001", mod.relpath, call.lineno, mod.scope_of(call),
+                f"{reason} — runs on the server loop because {origin}{via}",
+                f"inline:{detail}"))
+        if depth < _MAX_DEPTH:
+            for call in sc.calls_made:
+                callee = _resolve_callee(mod, idx, key, call)
+                if callee is not None:
+                    stack.append((callee, depth + 1))
+
+
+def check_rc001(modules: List[SourceModule]) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in modules:
+        # 1. async def bodies anywhere
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                sc = _BodyScanner(mod).scan(node)
+                for call, detail, reason in sc.hits:
+                    findings.append(Finding(
+                        "RC001", mod.relpath, call.lineno,
+                        mod.scope_of(call),
+                        f"{reason} — inside async def {node.name}",
+                        f"async:{detail}"))
+        # 2. inline=True handlers (+ helpers reachable from them)
+        idx = _function_index(mod)
+        for method, handler, lineno in _inline_handlers(mod):
+            origin = f"handler {method!r} is registered inline=True"
+            if isinstance(handler, ast.Lambda):
+                sc = _BodyScanner(mod)
+                sc.visit(handler.body)
+                for call, detail, reason in sc.hits:
+                    findings.append(Finding(
+                        "RC001", mod.relpath, call.lineno,
+                        mod.scope_of(call), f"{reason} — {origin}",
+                        f"inline:{detail}"))
+                continue
+            name = dotted_name(handler)
+            if name is None:
+                continue
+            if name.startswith("self.") or name.startswith("cls."):
+                attr = name.split(".", 1)[1]
+                scope = mod.scope_of(handler)
+                cls = scope.split(".")[0] if "." in scope else None
+                key = f"{cls}.{attr}" if cls and f"{cls}.{attr}" in idx \
+                    else next((k for k in idx if k.endswith(f".{attr}")),
+                              attr)
+            else:
+                key = name
+            _check_reachable(mod, idx, key, origin, findings)
+    return findings

@@ -1,0 +1,151 @@
+"""RC003 — rpc-contract: registered handlers vs. call sites.
+
+Collects every RPC method name the cluster registers:
+
+  * explicit ``server.register("Name", handler, ...)`` string literals,
+  * ``server.register_instance(self)`` sweeps — every public method of
+    the enclosing class becomes a handler (gcs/server.py,
+    raylet/raylet.py, util/client/server.py all use this),
+
+and every client call site: ``.call("Name", ...)``,
+``.call_retrying(...)``, ``.call_oneway(...)``, ``.acall(...)`` with a
+string-literal method. Two findings fall out:
+
+  * a call site whose method is registered NOWHERE — a typo'd name that
+    would surface at runtime as an ``RpcError: no handler`` hang/retry
+    loop, caught at lint time instead;
+  * an explicitly registered handler that no scanned call site ever
+    names — dead registration or a typo on the register side.
+    (register_instance sweeps are exempt: public methods of those
+    classes are also ordinary local API.)
+
+All servers share one namespace here (gcs/raylet/worker method names are
+disjoint by convention in this codebase), which keeps the rule simple
+and still catches every typo class PR 7/8 hit.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from tools.raycheck.rules import (
+    Finding,
+    SourceModule,
+    const_str,
+    terminal_attr,
+)
+
+_CALL_METHODS = {"call", "call_retrying", "call_oneway", "acall"}
+
+
+def _server_receiver(node: ast.Call) -> bool:
+    """Only ``<something server-shaped>.register(...)`` counts as an RPC
+    registration — ``pbt.register``, ``atexit.register``, poll-object
+    ``p.register`` are different APIs entirely."""
+    fn = node.func
+    if not isinstance(fn, ast.Attribute):
+        return False
+    recv = fn.value
+    name = recv.attr if isinstance(recv, ast.Attribute) else (
+        recv.id if isinstance(recv, ast.Name) else "")
+    lname = name.lower()
+    return "server" in lname or lname in ("srv", "rpc")
+
+
+def _registered_methods(modules: List[SourceModule]
+                        ) -> Tuple[Dict[str, Tuple[str, int]], Set[str]]:
+    """(explicit: name -> (path, line), instance_swept: names)."""
+    explicit: Dict[str, Tuple[str, int]] = {}
+    swept: Set[str] = set()
+    for mod in modules:
+        classes: Dict[str, ast.ClassDef] = {
+            n.name: n for n in mod.tree.body if isinstance(n, ast.ClassDef)}
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            attr = terminal_attr(node.func)
+            if attr == "register" and node.args and _server_receiver(node):
+                name = const_str(node.args[0])
+                if name:
+                    explicit.setdefault(name, (mod.relpath, node.lineno))
+            elif attr == "register_instance" and node.args and \
+                    isinstance(node.args[0], ast.Name) and \
+                    node.args[0].id == "self":
+                cls_name = mod.scope_of(node).split(".")[0]
+                cls = classes.get(cls_name)
+                if cls is None:
+                    continue
+                prefix = ""
+                for kw in node.keywords:
+                    if kw.arg == "prefix":
+                        prefix = const_str(kw.value) or ""
+                for item in cls.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)) and \
+                            not item.name.startswith("_"):
+                        swept.add(prefix + item.name)
+        # handler tables: a {"Name": callable, ...} dict literal is a
+        # registration idiom (test helpers loop over it calling
+        # ``register(name, fn)``). Count the keys ONLY when this module
+        # actually registers dynamically (a server-shaped .register()
+        # whose method arg is not a string literal) — without that gate,
+        # any unrelated string-keyed dict would mask typo'd-call
+        # findings tree-wide.
+        dynamic_register = any(
+            isinstance(node, ast.Call)
+            and terminal_attr(node.func) == "register"
+            and _server_receiver(node)
+            and node.args and const_str(node.args[0]) is None
+            for node in ast.walk(mod.tree))
+        if dynamic_register:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Dict) and node.keys and all(
+                        const_str(k) is not None and isinstance(
+                            v, (ast.Lambda, ast.Name, ast.Attribute))
+                        for k, v in zip(node.keys, node.values)):
+                    swept.update(const_str(k) for k in node.keys)
+    return explicit, swept
+
+
+def check_rc003(modules: List[SourceModule]) -> List[Finding]:
+    explicit, swept = _registered_methods(modules)
+    registered = set(explicit) | swept
+    called: Dict[str, Tuple[str, int, str]] = {}
+    call_sites: List[Tuple[SourceModule, ast.Call, str]] = []
+    for mod in modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and \
+                    terminal_attr(node.func) in _CALL_METHODS and \
+                    isinstance(node.func, ast.Attribute) and node.args:
+                name = const_str(node.args[0])
+                if name:
+                    called.setdefault(
+                        name, (mod.relpath, node.lineno, mod.scope_of(node)))
+                    call_sites.append((mod, node, name))
+    findings: List[Finding] = []
+    for mod, node, name in call_sites:
+        if name not in registered:
+            findings.append(Finding(
+                "RC003", mod.relpath, node.lineno, mod.scope_of(node),
+                f"RPC call to {name!r} has no registered handler anywhere "
+                f"in the scanned tree — typo'd method names hang at "
+                f"runtime ('no handler' RemoteError after the timeout)",
+                f"unregistered:{name}"))
+    for name, (path, line) in sorted(explicit.items()):
+        if name not in called:
+            # find the module to attribute the scope properly
+            scope = "<module>"
+            for mod in modules:
+                if mod.relpath == path:
+                    for node in ast.walk(mod.tree):
+                        if isinstance(node, ast.Call) and \
+                                node.lineno == line and \
+                                terminal_attr(node.func) == "register":
+                            scope = mod.scope_of(node)
+            findings.append(Finding(
+                "RC003", path, line, scope,
+                f"handler {name!r} is registered but never called from any "
+                f"scanned call site — dead registration or register-side "
+                f"typo", f"unused:{name}"))
+    return findings
