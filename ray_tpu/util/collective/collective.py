@@ -71,7 +71,12 @@ def is_group_initialized(group_name: str = "default") -> bool:
 
 def destroy_collective_group(group_name: str = "default") -> None:
     with _lock:
-        _groups.pop(group_name, None)
+        g = _groups.pop(group_name, None)
+    if g is not None and hasattr(g, "close"):
+        try:
+            g.close()
+        except Exception:  # noqa: BLE001 — best-effort shm release; the
+            pass           # group is already unregistered either way
 
 
 def get_rank(group_name: str = "default") -> int:

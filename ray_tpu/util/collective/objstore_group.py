@@ -4,52 +4,23 @@ Reference: torch-gloo group (util/collective/collective_group/
 torch_gloo_collective_group.py:290) rendezvoused via a TCP store. Here
 the rendezvous is a **named actor** (the same named-actor pattern the
 reference uses for the NCCL unique-id store, nccl_collective_group.py:37)
-and the data plane is the shared-memory object store: each rank puts its
-contribution, the rendezvous hands back everyone's ObjectRefs, ranks
-reduce locally (zero-copy reads on one node).
+and the data plane is chosen per op by the v2 selection table
+(`util/collective/v2/policy.py`): seqlock shm channels and chunked ring
+pipes for 2-rank groups, the hierarchical shm-arena + cross-host
+rendezvous composition for everything bigger, and the object store as
+the universal fallback.
 """
 
 from __future__ import annotations
 
-import contextlib
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 import ray_tpu
-from ray_tpu.observability import tracing as obs_tracing
+from ray_tpu.observability import collective as obs_col
 from ray_tpu.util.collective.types import ReduceOp
-
-def _bandwidth_histogram():
-    """Per-op effective bandwidth (MB/s) on the Prometheus scrape."""
-    from ray_tpu.util.metrics import get_histogram
-
-    return get_histogram(
-        "ray_tpu_collective_mb_per_s",
-        description="Collective op effective bandwidth",
-        boundaries=(1, 10, 50, 100, 500, 1000, 5000, 20000),
-        tag_keys=("op",),
-    )
-
-
-@contextlib.contextmanager
-def _op_span(op: str, nbytes: int, world_size: int, rank: int):
-    """Collective op start/end: a span (parents into whatever trace the
-    calling task inherited) plus the bandwidth histogram sample."""
-    t0 = time.monotonic()
-    with obs_tracing.span(
-            f"collective.{op}", kind="collective",
-            attrs={"op": op, "nbytes": nbytes,
-                   "world_size": world_size, "rank": rank}):
-        yield
-    dur = time.monotonic() - t0
-    if dur > 0 and nbytes:
-        try:
-            _bandwidth_histogram().observe(
-                nbytes / dur / 1e6, tags={"op": op})
-        except Exception:  # noqa: BLE001 — metrics must not fail the op
-            pass
 
 _NUMPY_REDUCERS = {
     ReduceOp.SUM: lambda xs: np.sum(xs, axis=0),
@@ -62,33 +33,105 @@ _NUMPY_REDUCERS = {
 
 @ray_tpu.remote
 class _Rendezvous:
-    """Collects one ObjectRef per rank per (op sequence number), releases
-    the full set once world_size contributions arrive."""
+    """Collects one ObjectRef per participating rank per (key, op
+    sequence number), releases the full set once every expected rank
+    contributed.
+
+    GC contract (PR-11 satellite — the pre-v2 version leaked per-seq
+    refs in >2-rank groups whenever a rank abandoned a sequence):
+
+    - a (key, seq) slot is dropped once every participant collected it;
+    - per-key WATERMARK gc: when every participant of a key has
+      collected some seq >= S, every slot of that key with seq <= S is
+      dropped — a rank that timed out of seq S and rejoined at S+1 (a
+      "late collector") can no longer strand S's refs forever;
+    - a bounded-directory assert on `put` turns any future leak into a
+      loud failure instead of silent actor-memory growth: with the
+      watermark gc, a key can only carry a couple of live sequences
+      (ranks are at most one collect apart, plus the bounded backlog of
+      abandoned seqs awaiting the watermark).
+    """
 
     def __init__(self, world_size: int):
         self.world_size = world_size
         self._slots: Dict[Tuple[str, int], Dict[int, Any]] = {}
-        self._collected: Dict[Tuple[str, int], set] = {}
+        # key -> {rank: highest seq that rank successfully collected}
+        self._wm: Dict[str, Dict[int, int]] = {}
+        self._max_live_per_key = 2 * world_size + 8
 
-    def put(self, key: str, seq: int, rank: int, ref: Any):
+    def put(self, key: str, seq: int, rank: int, ref: Any,
+            world_size: Optional[int] = None):
+        if world_size is not None and world_size != self.world_size:
+            # the named actor outlives groups: a put from a group sized
+            # differently than the incarnation that created this actor
+            # IS a new incarnation — adopt the new world (collect()'s
+            # expected set must match it) and reset the directory
+            self.world_size = world_size
+            self._max_live_per_key = 2 * world_size + 8
+            self._wm.clear()
+            for ks in [ks for ks in self._slots
+                       if not ks[0].startswith("p2p_")]:
+                self._slots.pop(ks, None)
+        if self._wm.get(key, {}).get(rank, -1) >= seq:
+            # a rank re-putting a sequence it already collected means a
+            # NEW group incarnation reuses this (named, persistent)
+            # rendezvous with reset counters. The old incarnation is
+            # dead GROUP-WIDE, so reset the whole directory: drop every
+            # watermark (a stale one would gc the fresh exchange out
+            # from under the new group's slower ranks) and every
+            # stranded slot — including partial slots on keys that
+            # never saw a collect, which could otherwise merge with the
+            # new incarnation's puts at the same seq and release stale
+            # refs. Only the FIRST new-incarnation put lands here (the
+            # reset clears the watermarks that trigger it), so fresh
+            # puts racing in behind it are never purged. p2p slots are
+            # NOT purged: they carry no watermark (so a fresh send made
+            # before the group's first collective would be wiped, not
+            # protected by the first-put-wins argument), and an
+            # undelivered old message surviving a re-init is the v1
+            # in-flight-message semantics.
+            # KNOWN LIMIT: a group that crashed before ANY collect
+            # completed leaves no watermark, so a same-name same-size
+            # re-incarnation cannot be distinguished from it — full
+            # fencing needs incarnation ids in the put protocol.
+            self._wm.clear()
+            for ks in [ks for ks in self._slots
+                       if not ks[0].startswith("p2p_")]:
+                self._slots.pop(ks, None)
         slot = self._slots.setdefault((key, seq), {})
         slot[rank] = ref
+        # the bounded-directory assert applies to collect/watermark-gc'd
+        # keys only: p2p slots are freed by collect_from, and a sender
+        # legitimately pipelines unboundedly ahead of its receiver
+        if not key.startswith("p2p_"):
+            live = sum(1 for k, _s in self._slots if k == key)
+            assert live <= self._max_live_per_key, (
+                f"rendezvous directory for key {key!r} grew to {live} "
+                f"live sequences (> {self._max_live_per_key}) — per-seq "
+                f"GC is leaking")
         return len(slot)
 
-    def collect(self, key: str, seq: int, rank: int = -1) -> Optional[List[Any]]:
+    def collect(self, key: str, seq: int, rank: int = -1,
+                ranks: Optional[List[int]] = None) -> Optional[List[Any]]:
+        """Full set for (key, seq) in participant order, or None while
+        incomplete. ``ranks`` names the expected participants (default:
+        the whole group) — the hier cross-host phase exchanges among
+        counterpart subsets."""
+        expected = tuple(ranks) if ranks is not None \
+            else tuple(range(self.world_size))
         slot = self._slots.get((key, seq), {})
-        if len(slot) < self.world_size:
+        if any(r not in slot for r in expected):
             return None
-        out = [slot[r] for r in range(self.world_size)]
-        # Auto-gc once EVERY rank has collected. (An eager rank-0 gc races
-        # with slower ranks, which would then see an empty slot forever and
-        # time out — advisor finding, round 1.)
+        out = [slot[r] for r in expected]
         if rank >= 0:
-            done = self._collected.setdefault((key, seq), set())
-            done.add(rank)
-            if len(done) >= self.world_size:
-                self._slots.pop((key, seq), None)
-                self._collected.pop((key, seq), None)
+            wm = self._wm.setdefault(key, {})
+            wm[rank] = max(wm.get(rank, -1), seq)
+            floor = min(wm.get(r, -1) for r in expected)
+            if floor >= 0:
+                dead = [ks for ks in self._slots
+                        if ks[0] == key and ks[1] <= floor]
+                for ks in dead:
+                    self._slots.pop(ks, None)
         return out
 
     def collect_from(self, key: str, seq: int, rank: int) -> Optional[Any]:
@@ -101,34 +144,54 @@ class _Rendezvous:
             self._slots.pop((key, seq), None)
         return ref
 
+    def collect_scatter(self, key: str, seq: int,
+                        senders: List[int]) -> Optional[List[Any]]:
+        """Single-collector variant: the full sender set for (key, seq)
+        in ``senders`` order, popped immediately (exactly one rank ever
+        collects a scatter key, so eager gc is safe — no watermark
+        needed)."""
+        slot = self._slots.get((key, seq), {})
+        if any(r not in slot for r in senders):
+            return None
+        self._slots.pop((key, seq), None)
+        return [slot[r] for r in senders]
+
     def gc(self, key: str, seq: int):
         self._slots.pop((key, seq), None)
         return True
+
+    def directory_stats(self) -> dict:
+        """Live-slot accounting for the GC tests."""
+        per_key: Dict[str, int] = {}
+        for k, _s in self._slots:
+            per_key[k] = per_key.get(k, 0) + 1
+        return {"live_slots": len(self._slots), "per_key": per_key}
 
 
 class ObjStoreGroup:
     """One instance per participating process/actor.
 
-    Data plane, chosen per tensor size (VERDICT r4 weak #6):
+    Data plane, chosen PER OP by the v2 selection table (policy.py has
+    the full table; README "Collectives" documents it):
 
-    - SMALL tensors (<= RAY_TPU_COLLECTIVE_CHANNEL_MAX_BYTES, default
-      2 MiB, group-agreed minimum): same-host groups use seqlock
-      shared-memory tensor channels — each rank writes once and reads
-      world_size-1 peers, zero actor round-trips in steady state. An
-      order of magnitude over the object path in the latency-bound
-      regime (recorded: ``allreduce_64kb_2rank_ops_s`` in
-      MICROBENCH.json vs ~0.1k ops/s for the object path at that size).
-    - LARGE tensors: the object-store path — zero-copy shm reads with
-      loose scheduling beat the channels' lockstep ack alternation
-      once memcpy+reduce dominate (A/B-measured at 8 MiB on the 1-CPU
-      CI host).
+    - SMALL tensors on one host ride seqlock shared-memory tensor
+      channels (all-to-all, zero actor round-trips in steady state).
+    - LARGE tensors in 2-rank groups ride the chunked pipelined ring
+      over shm pipes (v1 plane, 0.81 GB/s on the CI box).
+    - Everything bigger — >2 ranks and/or multiple hosts — rides the
+      hierarchical executor (v2): intra-host reduce-scatter over a shm
+      arena, cross-host counterpart exchange over the object path,
+      intra-host allgather fan-back, optionally with block-scaled int8
+      wire quantization (``RAY_TPU_COLLECTIVE_QUANT=int8``).
+    - The object path (rendezvous actor + object store) remains the
+      universal fallback and the cross-host transport.
 
-    The policy (enabled + threshold) is agreed across the group at
-    first use so per-rank env differences can never diverge the per-op
-    rendezvous keys. Channels are established lazily per (shape,
-    dtype) through one object-path exchange; groups spanning hosts
-    (hostnames differ at setup) always keep the object path, which
-    works across the chunked-pull object plane.
+    The policy (knobs + topology) is agreed across the group at first
+    use so per-rank env differences can never diverge the per-op
+    rendezvous keys, and each op's routing is re-agreed over a
+    fixed-shape meta channel (same host) or the object path (cross
+    host) — divergent shapes degrade to the object path, never
+    deadlock.
     """
 
     def __init__(self, world_size: int, rank: int, group_name: str = "default"):
@@ -137,6 +200,7 @@ class ObjStoreGroup:
         self.group_name = group_name
         self._seq = 0
         self._p2p_seqs: Dict[str, int] = {}
+        self._sub_seqs: Dict[str, int] = {}
         # (shape, dtype) -> (my_channel, [(rank, reader), ...]) or None
         # (None = cross-host group: stay on the object path)
         self._channels: Dict[Tuple, Optional[Tuple[Any, List]]] = {}
@@ -146,11 +210,12 @@ class ObjStoreGroup:
         # ring pipes for LARGE tensors: my pipe feeds my successor, I
         # read my predecessor's (() = unset, None = cross-host)
         self._pipes: Any = ()
-        # (enabled, max_bytes, pipe_chunk) agreed across ALL ranks at
-        # first use — per-rank env knobs must not diverge the per-op
-        # exchange keys (a rank going object-path while peers go
-        # channel-path would deadlock both rendezvous keys)
-        self._policy: Optional[Tuple[bool, int, int]] = None
+        # group-agreed GroupPolicy + Topology (policy_v2 exchange)
+        self._policy2 = None
+        self._topology = None
+        # size-bucketed host-local ShmArenas (v2 intra-host transport)
+        self._arenas: Dict[int, Any] = {}
+        self._exec = None
         name = f"__collective_rdv_{group_name}"
         if rank == 0:
             try:
@@ -173,56 +238,133 @@ class ObjStoreGroup:
         raise TimeoutError(f"collective rendezvous actor {name} not found")
 
     # ------------------------------------------------------------------
+    def _poll_collect(self, what: str, fn) -> List[Any]:
+        """Poll ``fn`` (a collect RPC returning the ref set or None)
+        with progressive backoff: each poll is a full RPC round trip
+        that costs CPU on both ends — on oversubscribed hosts a fixed
+        2 ms cadence steals the very cycles the slow peer needs to
+        reach its put (measured 2x+ on the hier xh phase)."""
+        deadline = time.time() + 120.0
+        nap = 0.002
+        while time.time() < deadline:
+            refs = fn()
+            if refs is not None:
+                return [ray_tpu.get(r[0]) for r in refs]
+            time.sleep(nap)
+            nap = min(nap * 1.5, 0.008)
+        raise TimeoutError(f"collective {what} timed out")
+
+    def _rdv_exchange(self, key: str, seq: int, value: Any,
+                      ranks: Optional[List[int]] = None) -> List[Any]:
+        """Put my value for (key, seq) and poll-collect every expected
+        participant's (default: the whole group)."""
+        ref = ray_tpu.put(value)
+        ray_tpu.get(self._rdv.put.remote(key, seq, self.rank, [ref],
+                                         world_size=self.world_size))
+        return self._poll_collect(
+            f"{key} (seq={seq})",
+            lambda: ray_tpu.get(
+                self._rdv.collect.remote(key, seq, self.rank, ranks)))
+
     def _exchange(self, key: str, value: Any) -> List[Any]:
         seq = self._seq
         self._seq += 1
-        ref = ray_tpu.put(value)
-        ray_tpu.get(self._rdv.put.remote(key, seq, self.rank, [ref]))
-        deadline = time.time() + 120.0
-        while time.time() < deadline:
-            refs = ray_tpu.get(self._rdv.collect.remote(key, seq, self.rank))
-            if refs is not None:
-                return [ray_tpu.get(r[0]) for r in refs]
-            time.sleep(0.002)
-        raise TimeoutError(f"collective {key} timed out (seq={seq})")
+        return self._rdv_exchange(key, seq, value)
+
+    def _sub_exchange(self, key: str, value: Any,
+                      ranks: List[int]) -> List[Any]:
+        """Object-path exchange among ``ranks`` only (the hier
+        cross-host phase): every participant's value, in ``ranks``
+        order. Participants must all call with identical (key, ranks);
+        per-key sequence counters keep repeated phases aligned without
+        touching the group-wide counter."""
+        assert self.rank in ranks
+        seq = self._sub_seqs.get(key, 0)
+        self._sub_seqs[key] = seq + 1
+        return self._rdv_exchange(key, seq, value, list(ranks))
+
+    def _scatter_exchange(self, key: str, per_dest: Dict[int, Any],
+                          ranks: List[int]) -> List[Any]:
+        """Pairwise scatter among ``ranks``: each participant publishes
+        one value PER destination and receives one value from every
+        other participant (sender order: ``ranks`` minus self). O(k)
+        bytes per rank where a dict over ``_sub_exchange`` would ship
+        O(k^2) — every peer would pull every other pair's shards just
+        to read its own entry."""
+        assert self.rank in ranks
+        seq = self._sub_seqs.get(key, 0)
+        self._sub_seqs[key] = seq + 1
+        for dest, val in per_dest.items():
+            ref = ray_tpu.put(val)
+            ray_tpu.get(self._rdv.put.remote(
+                f"{key}>{dest}", seq, self.rank, [ref],
+                world_size=self.world_size))
+        senders = [r for r in ranks if r != self.rank]
+        return self._poll_collect(
+            f"scatter {key} (seq={seq})",
+            lambda: ray_tpu.get(self._rdv.collect_scatter.remote(
+                f"{key}>{self.rank}", seq, senders)))
+
+    # -- group policy + topology (v2) ----------------------------------
+    def _ensure_policy(self):
+        """Agree the v2 policy AND topology across the group, once:
+        every rank contributes its env knobs plus its host key, the
+        merge is deterministic and conservative (see policy.py), and
+        the per-op routing decision is then identical on all ranks by
+        construction — divergent env vars degrade throughput, never
+        deadlock the rendezvous."""
+        if self._policy2 is not None:
+            return self._policy2
+        from ray_tpu.util.collective.v2 import policy as policy_mod
+        from ray_tpu.util.collective.v2 import topology as topo_mod
+
+        mine = tuple(policy_mod.local_knobs()) + (topo_mod.node_key(),)
+        if self.world_size > 1:
+            infos = [tuple(i) for i in self._exchange("policy_v2", mine)]
+        else:
+            infos = [mine]
+        self._policy2 = policy_mod.merge_knobs([i[:-1] for i in infos])
+        self._topology = topo_mod.Topology(self.rank,
+                                           [i[-1] for i in infos])
+        return self._policy2
+
+    def _executor(self):
+        if self._exec is None:
+            from ray_tpu.util.collective.v2.executor import (
+                HierarchicalExecutor,
+            )
+            self._exec = HierarchicalExecutor(self)
+        return self._exec
+
+    def _ensure_arena(self, nbytes: int):
+        """Host-local ShmArena with slots and region each >= nbytes,
+        bucketed to powers of two so every message size maps to a small
+        set of arenas. The local leader creates; names travel through
+        one world-wide exchange (every rank reaches the same rendezvous
+        key regardless of host), then each rank keeps its host
+        leader's arena."""
+        bucket = 1 << max(12, int(nbytes - 1).bit_length()) \
+            if nbytes > 1 else 4096
+        ar = self._arenas.get(bucket)
+        if ar is not None:
+            return ar
+        from ray_tpu.util.collective.v2.arena import ShmArena
+
+        topo = self._topology
+        name = None
+        if topo.is_local_leader:
+            ar = ShmArena(topo.local_world, topo.local_rank, bucket,
+                          bucket, create=True)
+            name = ar.name
+        infos = self._exchange(f"arenasetup_{bucket}", name)
+        if not topo.is_local_leader:
+            leader_name = infos[topo.leader(topo.my_host)]
+            ar = ShmArena(topo.local_world, topo.local_rank, bucket,
+                          bucket, name=leader_name, create=False)
+        self._arenas[bucket] = ar
+        return ar
 
     # -- shared-memory channel data plane ------------------------------
-    def _ensure_policy(self) -> Tuple[bool, int, int]:
-        """Agree the channel policy ACROSS the group, once: every rank
-        contributes its local env knobs, channels activate only when
-        every rank enables them, and the size threshold / pipeline chunk
-        size are the group minimum. The per-op routing decision is then
-        identical on all ranks by construction — divergent env vars
-        degrade throughput, never deadlock the rendezvous."""
-        if self._policy is not None:
-            return self._policy
-        import os
-
-        enabled = self.world_size > 1 and os.environ.get(
-            "RAY_TPU_COLLECTIVE_CHANNELS", "1") != "0"
-        try:
-            max_bytes = int(os.environ.get(
-                "RAY_TPU_COLLECTIVE_CHANNEL_MAX_BYTES", str(2 << 20)))
-        except ValueError:
-            max_bytes = 2 << 20
-        try:
-            pipe_chunk = int(os.environ.get(
-                "RAY_TPU_COLLECTIVE_PIPE_CHUNK_BYTES", str(1 << 20)))
-        except ValueError:
-            pipe_chunk = 1 << 20
-        pipe_chunk = max(4096, pipe_chunk)
-        if self.world_size > 1:
-            infos = self._exchange(
-                "channel_policy", (enabled, max_bytes, pipe_chunk))
-            enabled = all(i[0] for i in infos)
-            max_bytes = min(i[1] for i in infos)
-            # older two-field peers can't occur inside one group, but be
-            # defensive: default the chunk when absent
-            pipe_chunk = min(
-                (i[2] if len(i) > 2 else 1 << 20) for i in infos)
-        self._policy = (enabled, max_bytes, pipe_chunk)
-        return self._policy
-
     def _make_channel_set(self, shape, dtype, rdv_key: str):
         """One object-path exchange advertises every rank's channel;
         returns (my_channel, [(rank, reader), ...]) or None when the
@@ -258,7 +400,9 @@ class ObjStoreGroup:
         agreement. Set up through one shape-INDEPENDENT rendezvous
         ("metasetup") the first time any rank tries the channel plane —
         every rank reaches it regardless of tensor shapes, so setup
-        itself can't split across keys. None = cross-host group."""
+        itself can't split across keys. None = the ranks span real
+        hosts: the channel plane is off and per-op agreement falls back
+        to the object path."""
         if self._meta == ():
             self._meta = self._make_channel_set((2,), "int64", "metasetup")
         return self._meta
@@ -283,41 +427,61 @@ class ObjStoreGroup:
 
         return zlib.crc32(repr((arr.shape, str(arr.dtype))).encode())
 
-    def _op_route(self, arr: np.ndarray) -> str:
+    def _op_route(self, arr: np.ndarray, op_kind: str = "allreduce") -> str:
         """Decide THIS op's data plane — "channel" (small, per-shape
-        all-to-all seqlock channels), "pipe" (large, chunked pipelined
-        ring), or "object" (rendezvous actor + object store).
+        all-to-all seqlock channels), "pipe" (large 2-rank chunked
+        pipelined ring), "hier" (v2 hierarchical arena + cross-host
+        composition) or "object" (rendezvous actor + object store).
 
         The routing must be decided IDENTICALLY on every rank, but it
-        depends on per-rank state — the tensor's shape/size and each
-        rank's channel cache. So every op first exchanges (shape-sig,
-        nbytes) over a fixed-shape meta channel (a couple of seqlock shm
-        reads, no actor round-trips) and each rank applies the same rule
-        to the same vector: all metas equal → size decides channel vs
-        pipe; anything else → everyone takes the object path. Without
-        the per-op agreement, a rank whose (shape, dtype) is already
-        cached would skip the one-time rendezvous that peers with a
-        DIFFERENT shape are blocked in — mismatched-shape ops after a
-        matching warm-up, or ops straddling the size threshold, would
-        deadlock both sides for the full 120s and desync the exchange
-        seq (advisor finding)."""
-        enabled, max_bytes, _ = self._ensure_policy()
-        if not enabled:
-            return "object"  # group-agreed constant: identical everywhere
+        depends on per-rank state — the tensor's shape/size. So every
+        op first exchanges (shape-sig, nbytes): over a fixed-shape meta
+        channel when the ranks share a host (a couple of seqlock shm
+        reads, no actor round-trips), over the object path when they
+        don't (the cross-host phases dwarf one actor round-trip). Every
+        rank then applies the same selection table to the same vector:
+        all metas equal → policy.select_algorithm decides; anything
+        else → everyone takes the object path. Without the per-op
+        agreement, mismatched-shape ops after a matching warm-up, or
+        ops straddling a size threshold, would deadlock both sides for
+        the full 120s and desync the exchange seq (advisor finding)."""
+        from ray_tpu.util.collective.v2 import policy as policy_mod
+
+        pol = self._ensure_policy()
+        topo = self._topology
+        if self.world_size <= 1 or not pol.channels_enabled:
+            return "object"  # group-agreed constants: identical everywhere
+        # NOTE: no per-rank early returns below this line — dtype rides
+        # in the shape signature and select_algorithm's non-numeric
+        # check, so even a rank holding a different/non-numeric dtype
+        # participates in the agreement and degrades WITH the group
         meta = self._ensure_meta_channels()
-        if meta is None:
-            return "object"  # cross-host: symmetric on all ranks
-        meta_ch, meta_readers = meta
         sig = np.array([self._shape_sig(arr), arr.nbytes], np.int64)
-        meta_ch.write(sig, timeout=120.0)
-        agree = True
-        for _r, rd in meta_readers:
-            peer = rd.read(timeout=120.0)
-            if peer[0] != sig[0] or peer[1] != sig[1]:
-                agree = False  # keep reading: drain every peer's slot
-        if not agree:
-            return "object"  # same decision everywhere, by construction
-        return "channel" if arr.nbytes <= max_bytes else "pipe"
+        if meta is not None:
+            meta_ch, meta_readers = meta
+            meta_ch.write(sig, timeout=120.0)
+            agree = True
+            for _r, rd in meta_readers:
+                peer = rd.read(timeout=120.0)
+                if peer[0] != sig[0] or peer[1] != sig[1]:
+                    agree = False  # keep reading: drain every peer's slot
+            if not agree:
+                return "object"  # same decision everywhere, by construction
+        else:
+            # ranks span real hosts: only the hier plane is on the
+            # table. Short-circuit every SIZE-INDEPENDENT "object"
+            # answer (op kind, flat override, non-uniform topology)
+            # before paying the agreement round trip — size-dependent
+            # decisions must exchange first or ranks straddling a
+            # threshold would split
+            if topo.single_host or not topo.uniform \
+                    or pol.algo == "flat" or op_kind == "allgather":
+                return "object"
+            infos = self._exchange("hiermeta", (int(sig[0]), int(sig[1])))
+            if any(tuple(i) != (int(sig[0]), int(sig[1])) for i in infos):
+                return "object"
+        return policy_mod.select_algorithm(arr.nbytes, arr.dtype, topo, pol,
+                                           op_kind)
 
     def _channel_parts(self, arr: np.ndarray) -> Optional[List[np.ndarray]]:
         """Small-tensor plane: write mine once, read every peer's.
@@ -353,7 +517,7 @@ class ObjStoreGroup:
 
         from ray_tpu.experimental.channel import ChunkPipe, ChunkPipeReader
 
-        _, _, pipe_chunk = self._ensure_policy()
+        pipe_chunk = self._ensure_policy().pipe_chunk_bytes
         host = socket.gethostname()
         # four slots: enough in-flight chunks to ride out scheduler
         # jitter on oversubscribed hosts; identical constant on every
@@ -399,6 +563,15 @@ class ObjStoreGroup:
         ReduceOp.MIN: np.minimum,
     }
 
+    def _pipe_chunk_elems(self, nbytes: int, itemsize: int) -> int:
+        """Adaptive ring chunk (policy.chunk_bytes_for): pure function
+        of meta-agreed inputs, so every rank's chunk grid matches."""
+        from ray_tpu.util.collective.v2 import policy as policy_mod
+
+        chunk_bytes = policy_mod.chunk_bytes_for(
+            nbytes, self.world_size, self._ensure_policy())
+        return max(1, chunk_bytes // max(1, itemsize))
+
     def _pipeline_allreduce(self, arr: np.ndarray,
                             op: ReduceOp) -> Optional[np.ndarray]:
         """Chunked ring allreduce (reduce-scatter + allgather) over the
@@ -417,7 +590,6 @@ class ObjStoreGroup:
             return None
         mine, pred = pipes
         N = self.world_size
-        _, _, chunk_bytes = self._ensure_policy()
         op = ReduceOp(op)
         red = self._INPLACE_REDUCERS[op]
         flat = arr.reshape(-1)
@@ -431,7 +603,7 @@ class ObjStoreGroup:
             flat = flat.astype(
                 np.uint64 if flat.dtype.kind == "u" else np.int64)
         acc = np.empty_like(flat)
-        chunk_elems = max(1, chunk_bytes // max(1, acc.itemsize))
+        chunk_elems = self._pipe_chunk_elems(arr.nbytes, acc.itemsize)
         bounds = [(acc.size * i) // N for i in range(N + 1)]
 
         def seg(buf: np.ndarray, i: int) -> np.ndarray:
@@ -472,9 +644,8 @@ class ObjStoreGroup:
             return None
         mine, pred = pipes
         N = self.world_size
-        _, _, chunk_bytes = self._ensure_policy()
         flat = arr.reshape(-1)
-        chunk_elems = max(1, chunk_bytes // max(1, flat.itemsize))
+        chunk_elems = self._pipe_chunk_elems(arr.nbytes, flat.itemsize)
         parts: List[Any] = [None] * N
         parts[self.rank] = flat.copy()  # own part stays an independent copy
         for s in range(N - 1):
@@ -488,46 +659,81 @@ class ObjStoreGroup:
 
     def allreduce(self, tensor: Any, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
         arr = np.ascontiguousarray(tensor)
-        with _op_span("allreduce", arr.nbytes, self.world_size, self.rank):
+        with obs_col.op_span("allreduce", arr.nbytes, self.world_size,
+                             self.rank) as rec:
             route = self._op_route(arr)
+            if route == "hier":
+                return self._executor().allreduce(arr, ReduceOp(op), rec)
             if route == "pipe":
                 out = self._pipeline_allreduce(arr, ReduceOp(op))
                 if out is not None:
+                    rec["algo"] = "pipe"
                     return out
             elif route == "channel":
                 parts = self._channel_parts(arr)
                 if parts is not None:
+                    rec["algo"] = "channel"
                     return _NUMPY_REDUCERS[ReduceOp(op)](np.stack(parts))
+            rec["algo"] = "object"
             parts = self._exchange("allreduce", arr)
             return _NUMPY_REDUCERS[ReduceOp(op)](np.stack(parts))
 
     def allgather(self, tensor: Any) -> List[np.ndarray]:
         arr = np.ascontiguousarray(tensor)
-        with _op_span("allgather", arr.nbytes, self.world_size, self.rank):
-            route = self._op_route(arr)
+        with obs_col.op_span("allgather", arr.nbytes, self.world_size,
+                             self.rank) as rec:
+            route = self._op_route(arr, "allgather")
+            if route == "hier":
+                return self._executor().allgather(arr, rec)
             if route == "pipe":
                 parts = self._pipeline_allgather(arr)
                 if parts is not None:
+                    rec["algo"] = "pipe"
                     return parts
             elif route == "channel":
                 parts = self._channel_parts(arr)
                 if parts is not None:
+                    rec["algo"] = "channel"
                     return parts
+            rec["algo"] = "object"
             return self._exchange("allgather", arr)
 
     def reducescatter(self, tensor: Any, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
-        red = self.allreduce(tensor, op)
-        chunks = np.array_split(red, self.world_size, axis=0)
-        return chunks[self.rank]
+        """True reduce-scatter: each rank leaves with ONLY its shard of
+        the reduction (np.array_split axis-0 semantics — values are
+        identical to the historical allreduce-then-slice, without
+        materializing or fanning back the full tensor)."""
+        from ray_tpu.util.collective.v2.executor import shard_bounds
+
+        arr = np.ascontiguousarray(tensor)
+        with obs_col.op_span("reducescatter", arr.nbytes, self.world_size,
+                             self.rank) as rec:
+            route = self._op_route(arr, "reducescatter")
+            if route == "hier" and arr.ndim >= 1:
+                # ndim is shape-agreed, so the branch is identical on
+                # every rank; 0-d tensors raise in both paths
+                return self._executor().reducescatter(arr, ReduceOp(op), rec)
+            rec["algo"] = "object"
+            parts = self._exchange("reducescatter", arr)
+            offs, shapes = shard_bounds(arr.shape, self.world_size)
+            lo, hi = offs[self.rank], offs[self.rank + 1]
+            segs = [np.asarray(p).reshape(-1)[lo:hi] for p in parts]
+            red = _NUMPY_REDUCERS[ReduceOp(op)](np.stack(segs))
+            return red.reshape(shapes[self.rank])
 
     def broadcast(self, tensor: Any, src_rank: int = 0) -> np.ndarray:
-        arr = np.asarray(tensor)
-        with _op_span("broadcast", arr.nbytes, self.world_size, self.rank):
+        arr = np.ascontiguousarray(tensor)
+        with obs_col.op_span("broadcast", arr.nbytes, self.world_size,
+                             self.rank) as rec:
+            route = self._op_route(arr, "broadcast")
+            if route == "hier":
+                return self._executor().broadcast(arr, src_rank, rec)
+            rec["algo"] = "object"
             parts = self._exchange("broadcast", arr)
-            return parts[src_rank]
+            return np.asarray(parts[src_rank])
 
     def barrier(self) -> None:
-        with _op_span("barrier", 0, self.world_size, self.rank):
+        with obs_col.op_span("barrier", 0, self.world_size, self.rank):
             self._exchange("barrier", np.zeros(()))
 
     # -- p2p: per-pair sequence counters, single-rank collect -----------
@@ -536,16 +742,42 @@ class ObjStoreGroup:
         seq = self._p2p_seqs.get(key, 0)
         self._p2p_seqs[key] = seq + 1
         ref = ray_tpu.put(np.asarray(tensor))
-        ray_tpu.get(self._rdv.put.remote(key, seq, self.rank, [ref]))
+        ray_tpu.get(self._rdv.put.remote(key, seq, self.rank, [ref],
+                                         world_size=self.world_size))
 
     def recv(self, src_rank: int) -> np.ndarray:
         key = f"p2p_{src_rank}_{self.rank}"
         seq = self._p2p_seqs.get(key, 0)
         self._p2p_seqs[key] = seq + 1
-        deadline = time.time() + 120.0
-        while time.time() < deadline:
-            ref = ray_tpu.get(self._rdv.collect_from.remote(key, seq, src_rank))
-            if ref is not None:
-                return ray_tpu.get(ref[0])
-            time.sleep(0.002)
-        raise TimeoutError(f"recv from {src_rank} timed out (seq={seq})")
+
+        def once():
+            ref = ray_tpu.get(
+                self._rdv.collect_from.remote(key, seq, src_rank))
+            return None if ref is None else [ref]
+
+        return self._poll_collect(
+            f"recv from {src_rank} (seq={seq})", once)[0]
+
+    # ------------------------------------------------------------------
+    def close(self) -> None:
+        """Release every shm endpoint this group holds (channels, meta
+        channels, ring pipes, arenas). Called by
+        destroy_collective_group; safe to call more than once."""
+        for st in list(self._channels.values()):
+            if st:
+                st[0].close()
+                for _r, rd in st[1]:
+                    rd.close()
+        self._channels.clear()
+        if self._meta not in ((), None):
+            self._meta[0].close()
+            for _r, rd in self._meta[1]:
+                rd.close()
+        self._meta = ()
+        if self._pipes not in ((), None):
+            self._pipes[0].close()
+            self._pipes[1].close()
+        self._pipes = ()
+        for ar in list(self._arenas.values()):
+            ar.close()
+        self._arenas.clear()

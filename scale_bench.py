@@ -211,6 +211,69 @@ def bench_preempt_1of2_nodes(n_tasks: int) -> dict:
         cluster.shutdown()
 
 
+def bench_collective(n_ops: int) -> dict:
+    """Sustained-collective phase (PR 11): an 8-rank single-host group
+    runs a steady 8 MiB hierarchical-allreduce stream — the envelope
+    row is SUSTAINED throughput (mean over the whole stream, not a
+    best window), plus the per-phase breakdown from the last op's
+    flight-recorder event. Complements MICROBENCH's best-window
+    GB/s-vs-ranks curve."""
+    import numpy as np
+
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=0)
+    class Member:
+        def __init__(self, rank, world):
+            from ray_tpu.util import collective as col
+
+            col.init_collective_group(world, rank, backend="objstore",
+                                      group_name="sb_col")
+            self.arr = np.ones(8 * (1 << 20) // 4, np.float32)
+
+        def stream(self, iters):
+            import time as _t
+
+            from ray_tpu.util import collective as col
+
+            t0 = _t.perf_counter()
+            for _ in range(iters):
+                col.allreduce(self.arr, group_name="sb_col")
+            return _t.perf_counter() - t0
+
+        def last_phases(self):
+            from ray_tpu.observability.events import local_events
+
+            evs = local_events("collective_op")
+            return evs[-1]["phases"] if evs else {}
+
+        def destroy(self):
+            from ray_tpu.util import collective as col
+
+            col.destroy_collective_group("sb_col")
+            return True
+
+    world = 8
+    ws = [Member.remote(i, world) for i in range(world)]
+    ray_tpu.get([w.stream.remote(2) for w in ws], timeout=600)  # warm
+    t0 = time.perf_counter()
+    times = ray_tpu.get([w.stream.remote(n_ops) for w in ws], timeout=1800)
+    wall = time.perf_counter() - t0
+    phases = ray_tpu.get(ws[0].last_phases.remote(), timeout=60)
+    ray_tpu.get([w.destroy.remote() for w in ws], timeout=120)
+    nbytes = 8 * (1 << 20)
+    return {
+        "world_size": world,
+        "ops": n_ops,
+        "payload_mb": 8,
+        "sustained_gb_s": round(nbytes * n_ops / max(times) / 1e9, 3),
+        "aggregate_gb_s": round(
+            nbytes * n_ops * world / max(times) / 1e9, 3),
+        "wall_s": round(wall, 2),
+        "last_op_phases_s": phases,
+    }
+
+
 def bench_combined(n_tasks: int, n_actors: int) -> dict:
     """The mixed-phase shape: a 100k-task phase then a 2,000-actor phase
     through ONE driver (the reference's release suite runs them as
@@ -258,7 +321,8 @@ def _run_phase(phase: str, n: int, n2: int = 0) -> None:
     else:
         fn = {"many_tasks": bench_many_tasks,
               "many_actors": bench_many_actors,
-              "many_pgs": bench_many_pgs}[phase]
+              "many_pgs": bench_many_pgs,
+              "collective": bench_collective}[phase]
         out = fn(n)
     ray_tpu.shutdown()
     print("PHASE_JSON " + json.dumps(out), flush=True)
@@ -291,6 +355,7 @@ def main() -> None:
     n_actors = max(50, int(2_000 * args.scale))
     n_pgs = max(10, int(200 * args.scale))
     n_preempt = max(400, int(2_000 * args.scale))
+    n_col_ops = max(10, int(30 * args.scale))
 
     # one DRIVER PROCESS per phase, like the reference's release suite
     # (release_tests.yaml runs many_tasks / many_actors / many_pgs as
@@ -300,7 +365,8 @@ def main() -> None:
                   ("many_actors", n_actors, 0),
                   ("many_pgs", n_pgs, 0),
                   ("combined", n_tasks, n_actors),
-                  ("preempt_1of2_nodes", n_preempt, 0))
+                  ("preempt_1of2_nodes", n_preempt, 0),
+                  ("collective", n_col_ops, 0))
     if args.only:
         all_phases = tuple(p for p in all_phases if p[0] == args.only)
         try:

@@ -101,6 +101,35 @@ class TestObjStoreBackend:
             np.testing.assert_allclose(bc, np.full((2,), 1.0))
             assert [int(a[0]) for a in ag] == [0, 1]
 
+    def test_reducescatter_objstore(self, ray_start_regular):
+        """True reducescatter on the objstore backend: each rank gets
+        only its shard, values matching allreduce-then-slice (PR-11
+        satellite — previously degenerated to a full allreduce)."""
+        @ray_tpu.remote
+        class Worker:
+            def __init__(self, rank, world):
+                self.rank, self.world = rank, world
+
+            def run(self):
+                col.init_collective_group(
+                    self.world, self.rank, backend="objstore", group_name="g2"
+                )
+                out = col.reducescatter(
+                    np.arange(12, dtype=np.float32).reshape(6, 2)
+                    * (self.rank + 1),
+                    group_name="g2",
+                )
+                col.destroy_collective_group("g2")
+                return out
+
+        ws = [Worker.remote(i, 2) for i in range(2)]
+        outs = ray_tpu.get([w.run.remote() for w in ws])
+        full = np.arange(12, dtype=np.float32).reshape(6, 2) * 3  # 1x + 2x
+        ref = np.array_split(full, 2, axis=0)
+        for r, o in enumerate(outs):
+            assert o.shape == (3, 2)
+            np.testing.assert_allclose(o, ref[r])
+
     def test_send_recv(self, ray_start_regular):
         @ray_tpu.remote
         class Worker:

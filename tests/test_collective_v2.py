@@ -1,0 +1,656 @@
+"""Collective stack v2 tests — topology model, selection policy, int8
+block codec (adversarial accuracy vs the documented bound), the shm
+arena composition at 4 and 8 ranks, the fake-multi-host hierarchical
+path, true reducescatter semantics, and the rendezvous GC contract.
+
+Exactness bar: v2's exact mode must be BIT-identical to the v1
+reduction (``np.sum``/``np.mean``/.. over the stacked contributions),
+promotions included. Quantized mode must stay within
+``quant.sum_error_bound`` element-wise even for adversarial inputs
+(outlier blocks, denormals, all-zero blocks)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import collective as col
+from ray_tpu.util.collective.types import ReduceOp
+from ray_tpu.util.collective import v2
+from ray_tpu.util.collective.v2 import quant as quant_mod
+
+
+# =====================================================================
+# pure-python layers
+# =====================================================================
+class TestTopology:
+    def test_single_host(self):
+        t = v2.Topology(1, ["h", "h", "h"])
+        assert t.single_host and t.uniform
+        assert t.local_rank == 1 and t.local_world == 3
+        assert t.counterparts() == (1,)
+
+    def test_two_hosts_uniform(self):
+        t = v2.Topology(3, ["a", "a", "b", "b"])
+        assert not t.single_host and t.uniform and t.n_hosts == 2
+        assert t.local_rank == 1 and t.local_peers == (2, 3)
+        assert t.leader("b") == 2 and not t.is_local_leader
+        assert t.counterparts() == (1, 3)
+        assert t.counterparts(0) == (0, 2)
+
+    def test_non_uniform(self):
+        t = v2.Topology(0, ["a", "a", "b"])
+        assert not t.uniform
+
+    def test_interleaved_rank_order(self):
+        t = v2.Topology(2, ["a", "b", "a", "b"])
+        assert t.local_peers == (0, 2) and t.local_rank == 1
+        assert t.counterparts() == (2, 3)
+
+
+class TestPolicy:
+    def _pol(self, **kw):
+        base = dict(channels_enabled=True, channel_max_bytes=2 << 20,
+                    pipe_chunk_bytes=1 << 20, algo="auto", quant_mode="off",
+                    quant_min_bytes=1 << 20, quant_block=512,
+                    small_max_bytes=64 << 10, hier_min_bytes=256 << 10)
+        base.update(kw)
+        return v2.GroupPolicy(**base)
+
+    def test_selection_table(self):
+        pol = self._pol()
+        one = v2.Topology(0, ["h", "h"])
+        four = v2.Topology(0, ["h"] * 4)
+        xh = v2.Topology(0, ["a", "a", "b", "b"])
+        # world 2 single host keeps the v1 planes
+        assert v2.select_algorithm(1 << 10, np.float32, one, pol) == "channel"
+        assert v2.select_algorithm(8 << 20, np.float32, one, pol) == "pipe"
+        # world > 2: latency regime stays on channels, else hier
+        assert v2.select_algorithm(32 << 10, np.float32, four, pol) == "channel"
+        assert v2.select_algorithm(1 << 20, np.float32, four, pol) == "hier"
+        # cross-host: hier above the threshold, object below
+        assert v2.select_algorithm(1 << 20, np.float32, xh, pol) == "hier"
+        assert v2.select_algorithm(8 << 10, np.float32, xh, pol) == "object"
+        # non-uniform topologies can't form counterpart groups
+        skew = v2.Topology(0, ["a", "a", "b"])
+        assert v2.select_algorithm(1 << 20, np.float32, skew, pol) == "object"
+        # overrides
+        assert v2.select_algorithm(
+            1 << 20, np.float32, four, self._pol(algo="flat")) == "channel"
+        assert v2.select_algorithm(
+            1 << 10, np.float32, four, self._pol(algo="hier")) == "hier"
+        # degenerate cases
+        assert v2.select_algorithm(
+            1 << 20, np.float32, four,
+            self._pol(channels_enabled=False)) == "object"
+        assert v2.select_algorithm(1 << 20, np.object_, four, pol) == "object"
+        # op-specific rows: RS/broadcast have no channel/pipe planes
+        for kind in ("reducescatter", "broadcast"):
+            assert v2.select_algorithm(
+                1 << 10, np.float32, four, pol, kind) == "hier"
+            assert v2.select_algorithm(
+                1 << 20, np.float32, xh, pol, kind) == "hier"
+            assert v2.select_algorithm(
+                8 << 10, np.float32, xh, pol, kind) == "object"
+            assert v2.select_algorithm(
+                1 << 20, np.float32, four,
+                self._pol(algo="flat"), kind) == "object"  # kill switch
+        # multi-host allgather: hierarchy buys nothing
+        assert v2.select_algorithm(
+            8 << 20, np.float32, xh, pol, "allgather") == "object"
+        assert v2.select_algorithm(
+            8 << 20, np.float32, four, pol, "allgather") == "hier"
+
+    def test_merge_is_conservative(self):
+        a = list(v2.local_knobs())
+        b = list(a)
+        a[3], b[3] = "hier", "flat"      # any flat wins
+        a[4], b[4] = "int8", "int8"
+        a[5], b[5] = 1 << 20, 4 << 20    # quant_min: max
+        pol = v2.merge_knobs([tuple(a), tuple(b)])
+        assert pol.algo == "flat"
+        assert pol.quant_mode == "int8"
+        assert pol.quant_min_bytes == 4 << 20
+        b[4] = "off"                     # quant only when ALL opt in
+        assert v2.merge_knobs([tuple(a), tuple(b)]).quant_mode == "off"
+
+    def test_chunk_adaptivity(self):
+        pol = self._pol()
+        assert v2.chunk_bytes_for(8 << 20, 2, pol) == 1 << 20  # v1 default
+        assert v2.chunk_bytes_for(256 << 10, 2, pol) == 64 << 10
+        assert v2.chunk_bytes_for(8 << 20, 8, pol) == 256 << 10
+
+    def test_quant_gating(self):
+        four = v2.Topology(0, ["h"] * 4)
+        pol = self._pol(quant_mode="int8")
+        ok = v2.quant_codec_for(2 << 20, np.float32, ReduceOp.SUM, four, pol)
+        assert isinstance(ok, v2.Int8BlockCodec)
+        # below min size, non-float, non-SUM/MEAN, mode off -> exact
+        assert v2.quant_codec_for(
+            8 << 10, np.float32, ReduceOp.SUM, four, pol) is None
+        assert v2.quant_codec_for(
+            2 << 20, np.int32, ReduceOp.SUM, four, pol) is None
+        assert v2.quant_codec_for(
+            2 << 20, np.float32, ReduceOp.MAX, four, pol) is None
+        assert v2.quant_codec_for(
+            2 << 20, np.float32, ReduceOp.SUM, four, self._pol()) is None
+
+
+class TestBounds:
+    def test_seg_bounds_alignment(self):
+        b = v2.seg_bounds(100000, 4, align=512)
+        assert b[0] == 0 and b[-1] == 100000
+        for x in b[1:-1]:
+            assert x % 512 == 0
+        assert b == sorted(b)
+
+    def test_shard_bounds_match_array_split(self):
+        for shape in [(10, 3), (7,), (13, 2, 2), (3, 5)]:
+            for parts in (2, 3, 4, 8):
+                arr = np.arange(int(np.prod(shape))).reshape(shape)
+                offs, shapes = v2.shard_bounds(shape, parts)
+                ref = np.array_split(arr, parts, axis=0)
+                flat = arr.reshape(-1)
+                for i, r in enumerate(ref):
+                    assert shapes[i] == r.shape
+                    got = flat[offs[i]: offs[i + 1]].reshape(shapes[i])
+                    np.testing.assert_array_equal(got, r)
+
+    def test_zero_dim_rejected(self):
+        with pytest.raises(ValueError):
+            v2.shard_bounds((), 2)
+
+
+class TestInt8Codec:
+    def _roundtrip(self, x, block=128):
+        c = v2.Int8BlockCodec(x.dtype, block=block)
+        buf = np.empty(c.wire_nbytes(x.size), np.uint8)
+        c.encode_into(x, memoryview(buf))
+        return c.decode_slice(memoryview(buf), x.size, 0, x.size)
+
+    def test_roundtrip_within_bound(self):
+        rng = np.random.RandomState(0)
+        for n in (5, 127, 128, 129, 100003):
+            x = (rng.randn(n) * 100).astype(np.float32)
+            y = self._roundtrip(x)
+            bound = v2.sum_error_bound([x], 128, steps=1)
+            assert np.all(np.abs(x - y) <= bound)
+
+    def test_outlier_block(self):
+        # one 1e8 outlier dominates its block's scale: siblings in that
+        # block lose precision but stay within the documented bound
+        x = np.ones(256, np.float32)
+        x[10] = 1e8
+        y = self._roundtrip(x)
+        bound = v2.sum_error_bound([x], 128, steps=1)
+        assert np.all(np.abs(x - y) <= bound)
+        # the outlier-free block is untouched by the outlier
+        assert np.allclose(y[128:], 1.0, rtol=0.01)
+
+    def test_denormal_block_quantizes_to_zero(self):
+        x = np.full(128, 1e-40, np.float32)  # below the denormal floor
+        y = self._roundtrip(x)
+        assert np.all(y == 0.0)
+        assert np.all(np.abs(x - y) <= v2.sum_error_bound([x], 128, steps=1))
+
+    def test_all_zero_block_is_exact(self):
+        x = np.zeros(384, np.float32)
+        assert np.all(self._roundtrip(x) == 0.0)
+
+    def test_mixed_adversarial(self):
+        x = np.zeros(1024, np.float32)
+        x[0] = 3e7
+        x[100:128] = -1e-39
+        x[300:420] = np.linspace(-5, 5, 120, dtype=np.float32)
+        x[700] = np.float32(np.finfo(np.float32).tiny)
+        y = self._roundtrip(x)
+        assert np.all(np.abs(x - y) <= v2.sum_error_bound([x], 128, steps=1))
+
+    def test_nonfinite_block_poisons_to_nan(self):
+        """A block containing inf/NaN decodes as all-NaN (loud, never
+        silently-wrong ints); finite sibling blocks are untouched."""
+        import warnings
+
+        x = np.ones(384, np.float32)
+        x[10] = np.inf
+        x[200] = np.nan
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # no undefined-cast warnings
+            y = self._roundtrip(x)
+        assert np.all(np.isnan(y[:128]))    # inf block poisoned
+        assert np.all(np.isnan(y[128:256]))  # nan block poisoned
+        assert np.allclose(y[256:], 1.0, rtol=0.01)  # finite block fine
+
+    def test_float64_input(self):
+        x = np.random.RandomState(1).randn(999)
+        y = self._roundtrip(x)
+        assert np.all(np.abs(x - y) <= v2.sum_error_bound([x], 128, steps=1))
+
+    def test_range_encode(self):
+        x = (np.random.RandomState(2).randn(512) * 3).astype(np.float32)
+        c = v2.Int8BlockCodec(np.float32, block=128)
+        buf = np.zeros(c.wire_nbytes(512), np.uint8)
+        c.encode_into(x, memoryview(buf), 0, 128)
+        c.encode_into(x, memoryview(buf), 256, 512)
+        b = v2.sum_error_bound([x], 128, steps=1)
+        got = c.decode_slice(memoryview(buf), 512, 0, 128)
+        assert np.all(np.abs(x[:128] - got) <= b[:128])
+        got = c.decode_slice(memoryview(buf), 512, 256, 512)
+        assert np.all(np.abs(x[256:] - got) <= b[256:])
+
+    def test_decode_add_accumulates(self):
+        x = np.ones(256, np.float32)
+        c = v2.Int8BlockCodec(np.float32, block=128)
+        buf = np.empty(c.wire_nbytes(256), np.uint8)
+        c.encode_into(x, memoryview(buf))
+        out = np.full(256, 5.0, np.float32)
+        c.decode_slice(memoryview(buf), 256, 0, 256, out=out, add=True)
+        assert np.allclose(out, 6.0, rtol=0.01)
+
+    def test_exact_codec_bitwise(self):
+        for dt in (np.float32, np.int64, np.int8):
+            x = np.arange(-50, 50).astype(dt)
+            c = v2.ExactCodec(dt)
+            buf = np.empty(c.wire_nbytes(x.size), np.uint8)
+            c.encode_into(x, memoryview(buf))
+            np.testing.assert_array_equal(
+                c.decode_slice(memoryview(buf), x.size, 7, 63), x[7:63])
+
+
+# =====================================================================
+# cluster paths
+# =====================================================================
+@ray_tpu.remote(num_cpus=0)
+class _Member:
+    """One collective rank; optional per-rank env staging BEFORE the
+    group initializes (policy/topology knobs are read at agreement)."""
+
+    def __init__(self, rank, world, gname, env=None):
+        import os
+
+        for k, val in (env or {}).items():
+            os.environ[k] = val
+        self.gname = gname
+        col.init_collective_group(world, rank, backend="objstore",
+                                  group_name=gname)
+
+    def allreduce(self, arr, op="sum"):
+        return col.allreduce(arr, group_name=self.gname, op=ReduceOp(op))
+
+    def reducescatter(self, arr, op="sum"):
+        return col.reducescatter(arr, group_name=self.gname, op=ReduceOp(op))
+
+    def allgather(self, arr):
+        return col.allgather(arr, group_name=self.gname)
+
+    def broadcast(self, arr, src):
+        return col.broadcast(arr, src_rank=src, group_name=self.gname)
+
+    def last_op_event(self):
+        from ray_tpu.observability.events import local_events
+
+        evs = local_events("collective_op")
+        return evs[-1] if evs else None
+
+    def destroy(self):
+        col.destroy_collective_group(self.gname)
+        return True
+
+
+def _spawn(world, gname, env=None, envs=None):
+    return [_Member.remote(i, world, gname,
+                           envs[i] if envs else env) for i in range(world)]
+
+
+def _teardown(ws):
+    ray_tpu.get([w.destroy.remote() for w in ws], timeout=60)
+    for w in ws:
+        ray_tpu.kill(w)
+
+
+_V1_REDUCERS = {
+    "sum": lambda xs: np.sum(xs, axis=0),
+    "mean": lambda xs: np.mean(xs, axis=0),
+    "max": lambda xs: np.max(xs, axis=0),
+    "product": lambda xs: np.prod(xs, axis=0),
+}
+
+
+class TestHierSingleHost:
+    def test_4rank_exact_suite(self, ray_start_regular):
+        """Acceptance: 4-rank single-host hierarchical collectives, one
+        group end to end — allreduce across every reduce op BIT-identical
+        to the v1 reduction (promotions included), true-reducescatter
+        shard semantics, arena broadcast and allgather."""
+        rng = np.random.RandomState(3)
+        ws = _spawn(4, "v2_h4")
+        parts = [(rng.randn(220, 220) * 10 ** rng.randint(-3, 4)
+                  ).astype(np.float32) for _ in range(4)]  # ~190 KiB: hier
+        for op in ("sum", "mean", "max", "product"):
+            outs = ray_tpu.get(
+                [w.allreduce.remote(p, op) for w, p in zip(ws, parts)],
+                timeout=300)
+            expect = _V1_REDUCERS[op](np.stack(parts))
+            for o in outs:
+                assert o.dtype == expect.dtype
+                np.testing.assert_array_equal(o, expect)
+        # int32 sum promotes exactly like np.sum
+        ints = [np.full((200, 200), 2 ** 30, np.int32) for _ in range(4)]
+        outs = ray_tpu.get(
+            [w.allreduce.remote(p) for w, p in zip(ws, ints)], timeout=300)
+        expect = np.sum(np.stack(ints), axis=0)
+        for o in outs:
+            assert o.dtype == expect.dtype == np.int64
+            np.testing.assert_array_equal(o, expect)
+        ev = ray_tpu.get(ws[0].last_op_event.remote(), timeout=60)
+        assert ev["algo"] == "hier" and ev["codec"] == "exact"
+        assert {"encode", "reduce_local", "publish", "gather"} \
+            <= set(ev["phases"])
+        # true reducescatter: ONLY the rank's shard, v1-identical values
+        # — odd row counts and >1-d shapes included
+        for shape in [(10, 7), (13,), (9, 3, 2)]:
+            rs_in = [rng.randn(*shape).astype(np.float32) for _ in range(4)]
+            outs = ray_tpu.get(
+                [w.reducescatter.remote(p) for w, p in zip(ws, rs_in)],
+                timeout=300)
+            ref = np.array_split(np.sum(np.stack(rs_in), axis=0), 4, axis=0)
+            for r, o in enumerate(outs):
+                assert o.shape == ref[r].shape
+                np.testing.assert_array_equal(o, ref[r])
+        # arena broadcast + allgather on the same group
+        outs = ray_tpu.get(
+            [w.broadcast.remote(np.full((150, 150), float(i), np.float32), 2)
+             for i, w in enumerate(ws)], timeout=300)
+        for o in outs:
+            np.testing.assert_array_equal(
+                o, np.full((150, 150), 2.0, np.float32))
+        big = [np.full((200, 200), float(i), np.float32) for i in range(4)]
+        outs = ray_tpu.get(
+            [w.allgather.remote(b) for w, b in zip(ws, big)], timeout=300)
+        for o in outs:
+            for r in range(4):
+                np.testing.assert_array_equal(o[r], big[r])
+        _teardown(ws)
+
+    def test_divergent_dtypes_degrade_to_object_path(self, ray_start_regular):
+        """Ranks disagreeing on dtype must degrade TOGETHER to the
+        object path via the meta agreement — never split routes and
+        deadlock (regression: a per-rank dtype early-return bypassed
+        the agreement)."""
+        ws = _spawn(2, "v2_dtype")
+        a = np.full((120, 120), 1.0, np.float32)
+        b = np.full((120, 120), 2.0, np.float64)
+        outs = ray_tpu.get(
+            [ws[0].allreduce.remote(a), ws[1].allreduce.remote(b)],
+            timeout=300)
+        for o in outs:
+            np.testing.assert_allclose(o, np.full((120, 120), 3.0))
+        _teardown(ws)
+
+    def test_allreduce_8rank(self, ray_start_regular):
+        """Acceptance: 8-rank single-host hierarchical allreduce."""
+        ws = _spawn(8, "v2_h8")
+        parts = [np.full((180, 180), float(i + 1), np.float32)
+                 for i in range(8)]  # ~127 KiB -> hier at world 8
+        outs = ray_tpu.get(
+            [w.allreduce.remote(p) for w, p in zip(ws, parts)], timeout=300)
+        expect = np.sum(np.stack(parts), axis=0)
+        for o in outs:
+            np.testing.assert_array_equal(o, expect)
+        rs_in = [np.arange(64, dtype=np.float32).reshape(16, 4) * (i + 1)
+                 for i in range(8)]
+        outs = ray_tpu.get(
+            [w.reducescatter.remote(a) for w, a in zip(ws, rs_in)],
+            timeout=300)
+        chunks = np.array_split(np.sum(np.stack(rs_in), axis=0), 8, axis=0)
+        for r, o in enumerate(outs):
+            np.testing.assert_array_equal(o, chunks[r])
+        _teardown(ws)
+
+    def test_quantized_accuracy_adversarial(self, ray_start_regular):
+        """int8 allreduce of adversarial distributions stays within the
+        documented element-wise bound; quant only engages at/above
+        quant_min_bytes, and small messages fall back to the exact sum
+        bit-identically."""
+        env = {"RAY_TPU_COLLECTIVE_QUANT": "int8",
+               "RAY_TPU_COLLECTIVE_QUANT_MIN_BYTES": "65536",
+               "RAY_TPU_COLLECTIVE_QUANT_BLOCK": "128"}
+        ws = _spawn(4, "v2_q", env=env)
+        rng = np.random.RandomState(5)
+        n = 64 << 10  # 256 KiB f32 >= min -> quantized
+        parts = []
+        for i in range(4):
+            p = (rng.randn(n) * 10 ** rng.randint(-2, 3)).astype(np.float32)
+            p[i * 1000] = 1e7 * (i + 1)        # outlier blocks
+            p[2000 + i * 128: 2128 + i * 128] = 1e-40  # denormal blocks
+            p[5000:5128] = 0.0                 # all-zero block
+            parts.append(p)
+        outs = ray_tpu.get(
+            [w.allreduce.remote(p) for w, p in zip(ws, parts)], timeout=300)
+        exact = np.sum(np.stack(parts), axis=0)
+        bound = v2.sum_error_bound(
+            parts, 128, steps=quant_mod.QUANT_STEPS_SINGLE_HOST)
+        for o in outs:
+            assert o.dtype == np.float32
+            assert np.all(np.abs(o - exact) <= bound)
+        # all ranks observe the SAME post-roundtrip values
+        for o in outs[1:]:
+            np.testing.assert_array_equal(o, outs[0])
+        ev = ray_tpu.get(ws[0].last_op_event.remote(), timeout=60)
+        assert ev["codec"] == "int8"
+        # benign distribution: also inside the headline rtol
+        benign = [np.abs(rng.randn(n)).astype(np.float32) + 1.0
+                  for _ in range(4)]
+        outs = ray_tpu.get(
+            [w.allreduce.remote(p) for w, p in zip(ws, benign)], timeout=300)
+        np.testing.assert_allclose(
+            outs[0], np.sum(np.stack(benign), axis=0),
+            rtol=quant_mod.QUANT_RTOL)
+        # below quant_min: exact fallback, bit-identical to v1
+        small = [rng.randn(2048).astype(np.float32) for _ in range(4)]
+        outs = ray_tpu.get(
+            [w.allreduce.remote(p) for w, p in zip(ws, small)], timeout=300)
+        for o in outs:
+            np.testing.assert_array_equal(o, np.sum(np.stack(small), axis=0))
+        _teardown(ws)
+
+    def test_flat_override_keeps_v1_planes(self, ray_start_regular):
+        """algo=flat is the documented kill switch: EVERY op — allreduce,
+        reducescatter, broadcast — must stay off the v2 arena executor."""
+        ws = _spawn(4, "v2_flat", env={"RAY_TPU_COLLECTIVE_ALGO": "flat"})
+        parts = [np.full((220, 220), float(i + 1), np.float32)
+                 for i in range(4)]
+        outs = ray_tpu.get(
+            [w.allreduce.remote(p) for w, p in zip(ws, parts)], timeout=300)
+        for o in outs:
+            np.testing.assert_array_equal(o, np.sum(np.stack(parts), axis=0))
+        ev = ray_tpu.get(ws[0].last_op_event.remote(), timeout=60)
+        assert ev["algo"] != "hier"
+        outs = ray_tpu.get(
+            [w.reducescatter.remote(p) for w, p in zip(ws, parts)],
+            timeout=300)
+        ref = np.array_split(np.sum(np.stack(parts), axis=0), 4, axis=0)
+        for r, o in enumerate(outs):
+            np.testing.assert_array_equal(o, ref[r])
+        outs = ray_tpu.get(
+            [w.broadcast.remote(p, 1) for w, p in zip(ws, parts)],
+            timeout=300)
+        for o in outs:
+            np.testing.assert_array_equal(o, parts[1])
+        evs = ray_tpu.get(ws[0].last_op_event.remote(), timeout=60)
+        assert evs["algo"] != "hier"
+        _teardown(ws)
+
+
+class TestFakeMultiHost:
+    """RAY_TPU_COLLECTIVE_TOPOLOGY_KEY splits one box into fake hosts,
+    driving the full hierarchical composition (intra-host arenas +
+    cross-host counterpart exchange) in CI."""
+
+    def _envs(self, extra=None):
+        keys = ["hostA", "hostA", "hostB", "hostB"]
+        return [dict({"RAY_TPU_COLLECTIVE_TOPOLOGY_KEY": k}, **(extra or {}))
+                for k in keys]
+
+    def test_exact_across_fake_hosts(self, ray_start_regular):
+        """Cross-host exact reduction is deterministic and differs from
+        the flat order only by float reassociation — (h0_sum + h1_sum)
+        instead of sequential — so: float results within reassociation
+        tolerance AND identical on every rank; integer sums (associative)
+        bit-identical outright."""
+        ws = _spawn(4, "v2_xh", envs=self._envs())
+        rng = np.random.RandomState(6)
+        parts = [rng.randn(320, 320).astype(np.float32) for _ in range(4)]
+        outs = ray_tpu.get(
+            [w.allreduce.remote(p) for w, p in zip(ws, parts)], timeout=300)
+        expect = np.sum(np.stack(parts), axis=0)
+        for o in outs:
+            np.testing.assert_allclose(o, expect, rtol=1e-5, atol=1e-6)
+        for o in outs[1:]:
+            np.testing.assert_array_equal(o, outs[0])
+        ints = [np.full((320, 320), 3 * (i + 1), np.int32) for i in range(4)]
+        outs = ray_tpu.get(
+            [w.allreduce.remote(p) for w, p in zip(ws, ints)], timeout=300)
+        for o in outs:
+            np.testing.assert_array_equal(o, np.sum(np.stack(ints), axis=0))
+        # broadcasts from DIFFERENT sources: per-source exchange keys
+        # must keep sequence counters aligned (regression: a shared key
+        # deadlocked the second source's broadcast)
+        for src in (0, 1, 3):
+            outs = ray_tpu.get(
+                [w.broadcast.remote(
+                    np.full((320, 320), float(i), np.float32), src)
+                 for i, w in enumerate(ws)], timeout=300)
+            for o in outs:
+                np.testing.assert_array_equal(
+                    o, np.full((320, 320), float(src), np.float32))
+        ev = ray_tpu.get(ws[0].last_op_event.remote(), timeout=60)
+        assert ev["algo"] == "hier" and "xh" in ev["phases"]
+        # true reducescatter across fake hosts
+        rs_in = [rng.randn(12, 5).astype(np.float32) for _ in range(4)]
+        outs = ray_tpu.get(
+            [w.reducescatter.remote(p) for w, p in zip(ws, rs_in)],
+            timeout=300)
+        ref = np.array_split(np.sum(np.stack(rs_in), axis=0), 4, axis=0)
+        for r, o in enumerate(outs):
+            np.testing.assert_array_equal(o, ref[r])
+        _teardown(ws)
+
+    def test_quant_across_fake_hosts_within_bound(self, ray_start_regular):
+        extra = {"RAY_TPU_COLLECTIVE_QUANT": "int8",
+                 "RAY_TPU_COLLECTIVE_QUANT_MIN_BYTES": "65536",
+                 "RAY_TPU_COLLECTIVE_QUANT_BLOCK": "128"}
+        ws = _spawn(4, "v2_xhq", envs=self._envs(extra))
+        rng = np.random.RandomState(7)
+        n = 64 << 10
+        parts = [(rng.randn(n) * 50).astype(np.float32) for _ in range(4)]
+        parts[0][123] = 5e6  # outlier across the wire too
+        outs = ray_tpu.get(
+            [w.allreduce.remote(p) for w, p in zip(ws, parts)], timeout=300)
+        exact = np.sum(np.stack(parts), axis=0)
+        bound = v2.sum_error_bound(
+            parts, 128, steps=quant_mod.QUANT_STEPS_MULTI_HOST)
+        for o in outs:
+            assert np.all(np.abs(o - exact) <= bound)
+        for o in outs[1:]:
+            np.testing.assert_array_equal(o, outs[0])
+        _teardown(ws)
+
+
+class TestRendezvousGC:
+    """The _Rendezvous sequence-GC satellite: watermark gc for late
+    collectors, incarnation reset, and the bounded-directory assert."""
+
+    def _rdv(self, world):
+        from ray_tpu.util.collective.objstore_group import _Rendezvous
+
+        return _Rendezvous.remote(world)
+
+    def test_gc_contract(self, ray_start_regular):
+        """One cluster, four rendezvous actors: (a) late-collector
+        watermark gc, (b) subgroup collect+gc, (c) incarnation reset,
+        (d) the bounded-directory assert."""
+        # (a) ranks 0/1 collect seq 0, rank 2 abandons it (timeout path)
+        r = self._rdv(3)
+        for rank in range(3):
+            ray_tpu.get(r.put.remote("k", 0, rank, rank), timeout=30)
+        assert ray_tpu.get(r.collect.remote("k", 0, 0), timeout=30) is not None
+        assert ray_tpu.get(r.collect.remote("k", 0, 1), timeout=30) is not None
+        stats = ray_tpu.get(r.directory_stats.remote(), timeout=30)
+        assert stats["per_key"].get("k") == 1  # still live: rank 2 owed it
+        # the group moves on: everyone (rank 2 included) completes seq 1
+        for rank in range(3):
+            ray_tpu.get(r.put.remote("k", 1, rank, 10 + rank), timeout=30)
+        for rank in range(3):
+            assert ray_tpu.get(r.collect.remote("k", 1, rank),
+                               timeout=30) is not None
+        # watermark gc: rank 2 passed seq 0, so the abandoned slot is gone
+        stats = ray_tpu.get(r.directory_stats.remote(), timeout=30)
+        assert stats["live_slots"] == 0, stats
+
+        # (b) subgroup collect (the hier cross-host phase) gcs too
+        r = self._rdv(4)
+        for rank in (1, 3):
+            ray_tpu.get(r.put.remote("xh", 0, rank, rank), timeout=30)
+        assert ray_tpu.get(r.collect.remote("xh", 0, 1, [1, 3]),
+                           timeout=30) == [1, 3]
+        assert ray_tpu.get(r.collect.remote("xh", 0, 3, [1, 3]),
+                           timeout=30) == [1, 3]
+        stats = ray_tpu.get(r.directory_stats.remote(), timeout=30)
+        assert stats["live_slots"] == 0, stats
+
+        # (c) a NEW group incarnation reusing the persistent named
+        # rendezvous restarts sequences at 0; the stale watermark must
+        # not gc the fresh exchange out from under slower ranks
+        r = self._rdv(2)
+        for seq in range(3):
+            for rank in range(2):
+                ray_tpu.get(r.put.remote("k", seq, rank, rank), timeout=30)
+            for rank in range(2):
+                assert ray_tpu.get(r.collect.remote("k", seq, rank),
+                                   timeout=30) is not None
+        # a send() made by the new incarnation BEFORE its first
+        # collective must survive the reset purge (p2p slots carry no
+        # watermark protection, so they are exempted from it)
+        ray_tpu.get(r.put.remote("p2p_0_1", 0, 0, "msg"), timeout=30)
+        ray_tpu.get(r.put.remote("k", 0, 0, "fresh0"), timeout=30)
+        ray_tpu.get(r.put.remote("k", 0, 1, "fresh1"), timeout=30)
+        assert ray_tpu.get(r.collect.remote("k", 0, 0),
+                           timeout=30) == ["fresh0", "fresh1"]
+        assert ray_tpu.get(r.collect_from.remote("p2p_0_1", 0, 0),
+                           timeout=30) == "msg"
+
+        # (d) a genuine leak trips the bounded-directory assert loudly
+        r = self._rdv(2)
+        with pytest.raises(Exception, match="leaking"):
+            for seq in range(2 * 2 + 10):
+                ray_tpu.get(r.put.remote("leak", seq, 0, seq), timeout=30)
+
+        # (e) ...but p2p keys are exempt: a sender may pipeline
+        # unboundedly ahead of its receiver (collect_from frees those
+        # slots, not the watermark) — regression for the assert breaking
+        # deep producer/consumer send() queues
+        r = self._rdv(2)
+        for seq in range(2 * 2 + 10):
+            ray_tpu.get(r.put.remote("p2p_0_1", seq, 0, seq), timeout=30)
+        for seq in range(2 * 2 + 10):
+            assert ray_tpu.get(r.collect_from.remote("p2p_0_1", seq, 0),
+                               timeout=30) == seq
+        stats = ray_tpu.get(r.directory_stats.remote(), timeout=30)
+        assert stats["live_slots"] == 0, stats
+
+    def test_group_directory_stays_bounded(self, ray_start_regular):
+        """End-to-end: a >2-rank group (the leak report's shape) runs a
+        mixed op burst across fake hosts (sub-exchanges included) and
+        the rendezvous directory ends empty-ish."""
+        envs = [{"RAY_TPU_COLLECTIVE_TOPOLOGY_KEY": k}
+                for k in ("a", "a", "b", "b")]
+        ws = _spawn(4, "v2_gc", envs=envs)
+        arr = np.ones((320, 320), np.float32)
+        for _ in range(2):
+            ray_tpu.get([w.allreduce.remote(arr) for w in ws], timeout=300)
+            ray_tpu.get([w.broadcast.remote(arr, 0) for w in ws], timeout=300)
+        rdv = ray_tpu.get_actor("__collective_rdv_v2_gc")
+        stats = ray_tpu.get(rdv.directory_stats.remote(), timeout=30)
+        for key, live in stats["per_key"].items():
+            assert live <= 2 * 4 + 8, (key, stats)
+        _teardown(ws)

@@ -82,6 +82,7 @@ def _make_thread_ring(world, chunk=8192):
     """In-process ring harness: real pipes, no cluster — exercises the
     exact chunking/reduction code the actor path runs."""
     from ray_tpu.experimental.channel import ChunkPipe, ChunkPipeReader
+    from ray_tpu.util.collective import v2
     from ray_tpu.util.collective.objstore_group import ObjStoreGroup
 
     pipes = [ChunkPipe(chunk, num_slots=ObjStoreGroup._PIPE_SLOTS)
@@ -90,7 +91,11 @@ def _make_thread_ring(world, chunk=8192):
     for r in range(world):
         g = ObjStoreGroup.__new__(ObjStoreGroup)
         g.world_size, g.rank = world, r
-        g._policy = (True, 1024, chunk)
+        g._policy2 = v2.GroupPolicy(
+            channels_enabled=True, channel_max_bytes=1024,
+            pipe_chunk_bytes=chunk, algo="auto", quant_mode="off",
+            quant_min_bytes=1 << 20, quant_block=512,
+            small_max_bytes=64 << 10, hier_min_bytes=256 << 10)
         g._pipes = (pipes[r],
                     ChunkPipeReader(pipes[(r - 1) % world].name, chunk,
                                     num_slots=ObjStoreGroup._PIPE_SLOTS))

@@ -540,6 +540,73 @@ class TestBaseline:
 
 
 # =====================================================================
+# RC001 x collective v2 — blocking shm waits must never become
+# reachable from inline RPC handlers (PR-11 satellite)
+# =====================================================================
+
+class TestRC001CollectiveV2:
+    def test_collective_op_from_inline_handler_is_flagged(self, tmp_path):
+        """Wiring a v2 executor op into an inline handler is the exact
+        regression this rule guards: every collective op rendezvouses
+        with peer ranks and spins on shm counters."""
+        fs = _scan(tmp_path, "mod.py", """
+            class Server:
+                def __init__(self, srv, group):
+                    self._group = group
+                    srv.register("Reduce", self._reduce, inline=True)
+
+                def _reduce(self, arr):
+                    return self._group.allreduce(arr)
+        """, rules=["RC001"])
+        assert ("RC001", "inline:collective.allreduce") in _details(fs)
+
+    def test_arena_spin_reachable_from_inline_handler_is_flagged(
+            self, tmp_path):
+        """The arena-wait idiom (spin-then-nap on shm counters) reached
+        transitively from an inline handler — the time.sleep inside the
+        wait loop is the tell."""
+        fs = _scan(tmp_path, "mod.py", """
+            import time
+
+            class Exec:
+                def __init__(self, srv):
+                    srv.register("Gather", self._gather, inline=True)
+
+                def _gather(self):
+                    self._wait_posted()
+                    return 1
+
+                def _wait_posted(self):
+                    while not self._done():
+                        time.sleep(0.0001)
+
+                def _done(self):
+                    return True
+        """, rules=["RC001"])
+        assert ("RC001", "inline:time.sleep") in _details(fs)
+
+    def test_executor_methods_off_loop_are_clean(self, tmp_path):
+        # the same executor shape invoked from plain sync code (actor
+        # method, not a loop handler) is NOT a finding
+        fs = _scan(tmp_path, "mod.py", """
+            class Member:
+                def run(self, group, arr):
+                    return group.allreduce(arr)
+        """, rules=["RC001"])
+        assert fs == []
+
+    def test_v2_tree_has_no_loop_reachable_shm_waits(self):
+        """The shipped v2 executors themselves: zero RC001 findings —
+        no blocking shm wait is reachable from any inline RPC handler
+        (or async def) in the new subsystem."""
+        mods = load_modules(
+            [os.path.join(REPO, "ray_tpu", "util", "collective")],
+            root=REPO)
+        fs = [f for f in analyze(mods, rules=["RC001"])]
+        assert fs == [], "\n".join(f.render() for f in fs)
+
+
+# =====================================================================
 # live tree + CLI — the tier-1 enforcement point
 # =====================================================================
 

@@ -89,6 +89,17 @@ def blocking_reason(mod: SourceModule, call: ast.Call) -> Optional[Tuple[str, st
              f"for the full round-trip (use acall or call_oneway)")
     if attr in ("wait", "join") and not call.args and not _has_timeout(call):
         return f"{attr}", f"un-timeouted .{attr}() can park the loop forever"
+    if attr in ("allreduce", "allgather", "reducescatter", "broadcast",
+                "barrier") and (
+            "group" in lrecv or "executor" in lrecv or "collective" in lrecv
+            or lrecv == "col"):  # "col" = this repo's collective alias;
+        # one-letter receivers like "g" are too common to pattern-match
+        # the v2 collective stack: every op rendezvouses with peer ranks
+        # and spins on shm arena/channel counters — from loop code that
+        # parks the loop for the whole group's critical path
+        return f"collective.{attr}", \
+            (f"collective .{attr}() blocks on a group rendezvous and shm "
+             f"waits — never call it from loop code")
     return None
 
 
