@@ -25,6 +25,7 @@ import logging
 import threading
 import time
 import uuid
+import concurrent.futures
 from concurrent.futures import Future
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -1362,6 +1363,11 @@ class CoreWorker(CoreRuntime):
                 f = self.memory_store.as_future(oid)
                 try:
                     f.result(timeout=timeout)
+                except concurrent.futures.TimeoutError:
+                    # 3.10: futures.TimeoutError is NOT the builtin — a
+                    # bare `except TimeoutError` let the raw futures
+                    # timeout escape get() instead of GetTimeoutError
+                    raise GetTimeoutError(f"Get timed out for {oid.hex()}")
                 except TimeoutError:
                     raise GetTimeoutError(f"Get timed out for {oid.hex()}")
                 continue
@@ -2863,7 +2869,7 @@ class CoreWorker(CoreRuntime):
             self.memory_store.put(oid, ("plasma", node_id))
         with st.cv:
             st.arrived[index] = oid
-            st.cv.notify_all()
+            st.notify_locked()
             pending = len(st.arrived)
         return {"ok": True, "pending": pending}
 
@@ -2888,7 +2894,7 @@ class CoreWorker(CoreRuntime):
                 err = deserialize(error)
                 st.error = err.as_instanceof_cause() if isinstance(err, RayTaskError) else err
             st.total = count
-            st.cv.notify_all()
+            st.notify_locked()
         return {"ok": True}
 
     def _abandon_stream(self, task_id: TaskID) -> None:
@@ -2902,7 +2908,7 @@ class CoreWorker(CoreRuntime):
             st.arrived.clear()
             if st.total is None:
                 st.total = st.next_index
-            st.cv.notify_all()
+            st.notify_locked()
         for oid in oids:
             try:
                 self.free_object(oid)
@@ -2916,7 +2922,7 @@ class CoreWorker(CoreRuntime):
         with st.cv:
             if st.error is None and st.total is None:
                 st.error = err
-            st.cv.notify_all()
+            st.notify_locked()
 
     def _fail_actor_task(self, tid: TaskID, return_oids: List[ObjectID], err: Exception) -> None:
         with self._actor_pending_lock:

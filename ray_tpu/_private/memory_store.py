@@ -9,6 +9,7 @@ arrives or a timeout fires.
 from __future__ import annotations
 
 import threading
+import concurrent.futures
 from concurrent.futures import Future
 from typing import Any, Dict, List, Optional
 
@@ -68,7 +69,8 @@ class MemoryStore:
         f = self.as_future(oid)
         try:
             return f.result(timeout=timeout)
-        except TimeoutError:
+        except (TimeoutError, concurrent.futures.TimeoutError):
+            # 3.10: futures.TimeoutError is not the builtin — catch both
             raise GetTimeoutError(f"Get timed out for object {oid.hex()}")
 
     def delete(self, oid: ObjectID) -> None:

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, Optional, TYPE_CHECKING
+from typing import Callable, Dict, List, Optional, TYPE_CHECKING
 
 from ray_tpu._private.ids import ObjectID, TaskID
 from ray_tpu._private.object_ref import ObjectRef
@@ -21,6 +21,12 @@ from ray_tpu.exceptions import GetTimeoutError
 
 if TYPE_CHECKING:  # pragma: no cover
     from ray_tpu._private.core_worker import CoreWorker
+
+
+class StreamEnd(Exception):
+    """Async end-of-stream marker: ``anext_ref`` cannot raise
+    StopIteration (PEP 479 turns it into a bare RuntimeError inside a
+    coroutine), so exhaustion surfaces as this instead."""
 
 
 class _StreamState:
@@ -32,6 +38,22 @@ class _StreamState:
         self.next_index = 0  # next index to hand to the consumer
         self.total: Optional[int] = None  # set by StreamingDone
         self.error: Optional[BaseException] = None
+        # async consumers (the serve proxy loop) park a thread-safe
+        # waker here instead of blocking a thread on the cv; fired on
+        # every state change alongside the cv notify
+        self.wakers: List[Callable[[], None]] = []
+
+    def notify_locked(self) -> None:
+        """State changed (yield arrived / done / error / abandon): wake
+        every consumer. Must be called with ``cv`` held. Wakers are
+        drained — an async consumer re-registers per wait."""
+        self.cv.notify_all()
+        wakers, self.wakers = self.wakers, []
+        for w in wakers:
+            try:
+                w()
+            except Exception:  # noqa: BLE001 — a dead consumer loop
+                pass  # must not break delivery to the live ones
 
 
 class ObjectRefGenerator:
@@ -73,29 +95,82 @@ class ObjectRefGenerator:
         """Like ``next()`` but with a timeout (raises GetTimeoutError)."""
         return self._next(timeout=timeout)
 
+    def _take_locked(self) -> Optional[ObjectRef]:
+        """One non-blocking state inspection (``st.cv`` held): returns
+        the next ref, raises the stream's terminal error/StopIteration,
+        or returns None when the consumer must wait."""
+        st = self._state
+        if st.next_index in st.arrived:
+            oid = st.arrived.pop(st.next_index)
+            st.next_index += 1
+            return ObjectRef(oid, owner_addr=self._core.address)
+        if st.error is not None:
+            self._core._streams.pop(self._task_id, None)
+            self._fire_close()
+            raise st.error
+        if st.total is not None and st.next_index >= st.total:
+            self._core._streams.pop(self._task_id, None)
+            self._fire_close()
+            raise StopIteration
+        return None
+
     def _next(self, timeout: Optional[float]) -> ObjectRef:
         st = self._state
         deadline = None if timeout is None else time.monotonic() + timeout
         with st.cv:
             while True:
-                if st.next_index in st.arrived:
-                    oid = st.arrived.pop(st.next_index)
-                    st.next_index += 1
-                    return ObjectRef(oid, owner_addr=self._core.address)
-                if st.error is not None:
-                    self._core._streams.pop(self._task_id, None)
-                    self._fire_close()
-                    raise st.error
-                if st.total is not None and st.next_index >= st.total:
-                    self._core._streams.pop(self._task_id, None)
-                    self._fire_close()
-                    raise StopIteration
+                ref = self._take_locked()
+                if ref is not None:
+                    return ref
                 remaining = None if deadline is None else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
                     raise GetTimeoutError(
                         f"no yield from streaming task {self._task_id.hex()[:12]} in time"
                     )
                 st.cv.wait(timeout=remaining if remaining is not None else 1.0)
+
+    async def anext_ref(self, timeout: Optional[float] = None) -> ObjectRef:
+        """Async ``next_ref``: waits on the consumer's event loop without
+        parking a thread per stream (the serve proxy serves hundreds of
+        concurrent streams off one loop). Raises GetTimeoutError on
+        timeout and :class:`StreamEnd` on exhaustion (StopIteration
+        cannot cross a coroutine boundary)."""
+        import asyncio
+
+        st = self._state
+        loop = asyncio.get_event_loop()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with st.cv:
+                try:
+                    ref = self._take_locked()
+                except StopIteration:
+                    raise StreamEnd() from None
+                if ref is not None:
+                    return ref
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise GetTimeoutError(
+                        f"no yield from streaming task "
+                        f"{self._task_id.hex()[:12]} in time")
+                fut = loop.create_future()
+
+                def _wake(fut=fut):
+                    def _set():
+                        if not fut.done():
+                            fut.set_result(True)
+                    loop.call_soon_threadsafe(_set)
+
+                st.wakers.append(_wake)
+            try:
+                # bounded re-check even with no deadline: a waker lost to
+                # a dying producer must not hang the consumer forever
+                await asyncio.wait_for(
+                    fut, timeout=min(remaining, 1.0)
+                    if remaining is not None else 1.0)
+            except asyncio.TimeoutError:
+                pass  # loop re-checks state / deadline
 
     def completed(self) -> bool:
         st = self._state

@@ -109,6 +109,7 @@ class ContinuousLLMEngine(LLMEngine):
                 if exc is not None:
                     out.set_exception(exc)
                 else:
+                    # raycheck: disable=RC001 — done-callback: f resolved
                     out.set_result(tok.decode(f.result()))
             except BaseException as e:  # noqa: BLE001
                 if not out.done():

@@ -51,9 +51,14 @@ def build_llm_deployment(config: LLMConfig):
 
         def __call__(self, prompt: str) -> str:
             if config.continuous_batching:
+                from ray_tpu.serve import slo
+
                 # iteration-level scheduling: this request joins the
-                # running decode batch the moment a KV slot frees
-                return self.engine.submit(prompt).result()
+                # running decode batch the moment a KV slot frees; the
+                # wait is bounded by the request's deadline (expiry →
+                # DeadlineExceededError → 504 at the front door)
+                return slo.result_within_deadline(
+                    self.engine.submit(prompt))
             return self._generate_batch(prompt)
 
         def engine_stats(self) -> dict:

@@ -95,7 +95,13 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
                 b = store.get(key)
                 if b is None:
                     b = store[key] = _Batcher(call, max_batch_size, batch_wait_timeout_s)
-            return b.submit(item).result()
+            from ray_tpu.serve import slo
+
+            # inside a replica the active request's deadline bounds the
+            # batch wait (expiry surfaces as DeadlineExceededError → 504
+            # at the front door); outside one (plain function batching)
+            # the serve-wide cap applies — never unbounded
+            return slo.result_within_deadline(b.submit(item))
 
         wrapper._is_serve_batch = True
         return wrapper

@@ -19,6 +19,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu.serve import slo
 from ray_tpu.serve.deployment import (
     Application,
     Deployment,
@@ -65,6 +66,7 @@ class Replica:
         self._max_ongoing = max_ongoing_requests  # 0 = unenforced
         self._ongoing = 0
         self._ongoing_peak = 0
+        self._deadline_rejects = 0  # arrived with no budget left
         self._ongoing_lock = threading.Lock()
         # streams get their OWN cap, below the request cap, so
         # long-lived streams can't occupy every slot and starve unary
@@ -90,14 +92,31 @@ class Replica:
     def ongoing_stats(self) -> Dict[str, int]:
         with self._ongoing_lock:
             return {"ongoing": self._ongoing, "peak": self._ongoing_peak,
-                    "max": self._max_ongoing}
+                    "max": self._max_ongoing,
+                    "deadline_rejects": self._deadline_rejects}
 
-    def _maybe_await(self, out, model_id: str = ""):
+    def _check_deadline(self, deadline_s: Optional[float]
+                        ) -> Optional[slo.Deadline]:
+        """Re-anchor the caller's relative budget against this clock;
+        raise if it already ran out in flight / in the replica queue —
+        executing a request nobody is waiting for is pure waste."""
+        if deadline_s is None:
+            return None
+        if deadline_s <= 0:
+            with self._ongoing_lock:
+                self._deadline_rejects += 1
+            raise slo.DeadlineExceededError(
+                "request deadline exceeded before the replica started "
+                "executing")
+        return slo.Deadline(deadline_s)
+
+    def _maybe_await(self, out, model_id: str = "", deadline=None):
         """Async deployment callables run on a per-replica event loop
         (reference: replicas are fully async in serve/_private/replica.py).
-        The multiplexed model id is re-set INSIDE the coroutine: the Task
-        created on the loop thread copies that thread's context, not the
-        request thread's, so the contextvar would otherwise read empty."""
+        The multiplexed model id and request deadline are re-set INSIDE
+        the coroutine: the Task created on the loop thread copies that
+        thread's context, not the request thread's, so the contextvars
+        would otherwise read empty."""
         import asyncio
         import inspect
 
@@ -115,45 +134,66 @@ class Replica:
             from ray_tpu.serve.multiplex import _current_model_id
 
             token = _current_model_id.set(model_id)
+            dtoken = slo._request_deadline.set(deadline)
             try:
                 return await out
             finally:
+                slo._request_deadline.reset(dtoken)
                 _current_model_id.reset(token)
 
-        return asyncio.run_coroutine_threadsafe(
-            _with_model_id(), self._loop).result()
+        fut = asyncio.run_coroutine_threadsafe(_with_model_id(), self._loop)
+        # the request deadline bounds the wait; without one, a generous
+        # fixed cap (no serve-path wait is allowed to be unbounded)
+        timeout = deadline.remaining_or_raise() if deadline is not None \
+            else slo.MAX_TIMEOUT_S
+        import concurrent.futures
+
+        try:
+            return fut.result(timeout=timeout)
+        except (TimeoutError, concurrent.futures.TimeoutError):
+            # 3.10: futures.TimeoutError is not the builtin — catch both
+            fut.cancel()
+            raise slo.DeadlineExceededError(
+                "request deadline exceeded while executing") from None
 
     def handle_request(self, method: str, args, kwargs,
-                       multiplexed_model_id: str = ""):
+                       multiplexed_model_id: str = "",
+                       deadline_s: Optional[float] = None):
         from ray_tpu.serve.multiplex import _current_model_id
 
+        deadline = self._check_deadline(deadline_s)
         token = _current_model_id.set(multiplexed_model_id)
+        dtoken = slo._request_deadline.set(deadline)
         try:
             if method == "__call__":
                 return self._maybe_await(self._callable(*args, **kwargs),
-                                         multiplexed_model_id)
+                                         multiplexed_model_id, deadline)
             return self._maybe_await(
                 getattr(self._callable, method)(*args, **kwargs),
-                multiplexed_model_id)
+                multiplexed_model_id, deadline)
         finally:
+            slo._request_deadline.reset(dtoken)
             _current_model_id.reset(token)
 
     def handle_request_with_rejection(self, method: str, args, kwargs,
-                                      multiplexed_model_id: str = ""):
+                                      multiplexed_model_id: str = "",
+                                      deadline_s: Optional[float] = None):
         """Accept-or-reject at the replica's own cap: returns a
         ``_Rejected`` sentinel instead of queueing past
         ``max_ongoing_requests`` (reference: replica.py:1630). The
-        handle retries elsewhere with backoff."""
+        handle retries elsewhere with backoff. A dead-on-arrival
+        deadline raises DeadlineExceededError instead of executing."""
         if not self._acquire_slot():
             return _Rejected(self._ongoing)
         try:
             return self.handle_request(method, args, kwargs,
-                                       multiplexed_model_id)
+                                       multiplexed_model_id, deadline_s)
         finally:
             self._release_slot()
 
     def handle_request_streaming(self, method: str, args, kwargs,
-                                 multiplexed_model_id: str = ""):
+                                 multiplexed_model_id: str = "",
+                                 deadline_s: Optional[float] = None):
         """Generator method: the actor-streaming machinery turns each yield
         into an ObjectRefGenerator item on the caller (replica.py:1630).
         Streams occupy a capacity slot for their whole lifetime, visible
@@ -161,34 +201,44 @@ class Replica:
         (max_ongoing - 1, floored at 1 so a cap-1 replica can still
         stream): a burst of long-lived streams saturating every replica
         slot would starve unary traffic until a stream ends. At the
-        stream cap the call raises BEFORE the first yield (the consumer
-        sees the error as the stream's first item) instead of queueing
-        past the cap."""
+        stream cap the call raises OverloadedError BEFORE the first
+        yield (the consumer sees it as the stream's first item — the
+        proxy can still shed with a clean 503 because no response byte
+        exists yet) instead of queueing past the cap. A deadline that
+        expires mid-stream raises DeadlineExceededError between yields
+        (the proxy's documented terminal frame)."""
         from ray_tpu.serve.multiplex import _current_model_id
 
+        deadline = self._check_deadline(deadline_s)
         with self._ongoing_lock:
             if self._max_streams and self._streams >= self._max_streams:
-                raise RuntimeError(
+                raise slo.OverloadedError(
                     f"replica stream capacity exhausted "
                     f"({self._streams}/{self._max_streams} streams)")
             if self._max_ongoing and self._ongoing >= self._max_ongoing:
                 # the overall request cap binds streams too — now that
                 # streams reject pre-first-yield, admitting past it would
                 # let stream bursts exceed the configured concurrency
-                raise RuntimeError(
+                raise slo.OverloadedError(
                     f"replica capacity exhausted "
                     f"({self._ongoing}/{self._max_ongoing} requests)")
             self._streams += 1
             self._ongoing += 1
             self._ongoing_peak = max(self._ongoing_peak, self._ongoing)
         token = _current_model_id.set(multiplexed_model_id)
+        dtoken = slo._request_deadline.set(deadline)
         try:
             if method == "__call__":
                 out = self._callable(*args, **kwargs)
             else:
                 out = getattr(self._callable, method)(*args, **kwargs)
-            yield from out
+            for item in out:
+                if deadline is not None and deadline.expired():
+                    raise slo.DeadlineExceededError(
+                        "request deadline exceeded mid-stream")
+                yield item
         finally:
+            slo._request_deadline.reset(dtoken)
             _current_model_id.reset(token)
             with self._ongoing_lock:
                 self._streams -= 1
@@ -240,6 +290,9 @@ class ServeController:
 
     _RECONCILE_PERIOD_S = 0.25
     _DRAIN_GRACE_S = 3.0
+    # a replica retired on SUSPICION (failed health check) keeps running
+    # long enough for in-flight streams to finish before the reap
+    _SUSPECT_REAP_GRACE_S = 30.0
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -287,6 +340,10 @@ class ServeController:
             # headroom over the request cap so the accept-or-reject check
             # itself never queues behind executing requests
             max_concurrency=max(2, spec["max_ongoing_requests"]) + 4,
+            # survive node churn: a drained node's replicas migrate via
+            # the PR-8 DrainActor protocol instead of dying with it —
+            # handles cover the restart window with idempotent retry
+            max_restarts=int(opts.get("max_restarts", 2)),
             num_cpus=opts.get("num_cpus"),
             num_tpus=opts.get("num_tpus", 0),
             resources=opts.get("resources"),
@@ -369,6 +426,70 @@ class ServeController:
             st.handle_metrics[handle_id] = (float(ongoing), time.monotonic())
         return True
 
+    def _actor_state(self, actor_id_hex: str) -> Optional[str]:
+        """The GCS's view of a replica actor — the drain-awareness
+        signal: a RESTARTING actor is mid-migration (PR-8 graceful
+        drain), not dead."""
+        try:
+            from ray_tpu._private import worker as worker_mod
+
+            info = worker_mod._require_connected().core.gcs.call(
+                "GetActorInfo", actor_id=actor_id_hex, timeout=10)
+            return None if info is None else info.get("state")
+        except Exception:  # noqa: BLE001 — GCS blip: unknown state
+            return None
+
+    def report_replica_down(self, name: str, actor_id_hex: str) -> bool:
+        """A handle observed this replica fail. Verify before acting —
+        two distinct cases, and killing in the wrong one destroys a
+        live stream:
+
+        * the replica's actor is RESTARTING/PENDING in the GCS — the
+          PR-8 drain is migrating it off a preempted node; it will come
+          back at a new address. Do nothing (the reporting handle's
+          down-mark, which has a TTL, reroutes its own traffic).
+        * the actor is gone, DEAD, or ALIVE-but-hung (fails a health
+          check twice over) — retire it, bump the version so every
+          handle reroutes, and let the reconcile loop top back up."""
+        with self._lock:
+            st = self._deployments.get(name)
+            if st is None:
+                return False
+            victim = next((a for a in st.replicas
+                           if a._actor_id.hex() == actor_id_hex), None)
+        if victim is None:
+            return False  # already retired (or a stale report)
+        state = self._actor_state(actor_id_hex)
+        if state in ("RESTARTING", "PENDING"):
+            return False  # planned migration — the replica comes back
+        if state != "DEAD":
+            try:
+                ray_tpu.get(victim.health_check.remote(), timeout=5.0)
+                return False  # alive: the handle hit a transient blip
+            except Exception:  # noqa: BLE001 — dead or hung; re-check
+                pass
+            # the health check races the drain window: re-read the state
+            # so a migration that STARTED during the check isn't killed
+            state = self._actor_state(actor_id_hex)
+            if state in ("RESTARTING", "PENDING"):
+                return False
+        with self._lock:
+            st = self._deployments.get(name)
+            if st is None or victim not in st.replicas:
+                return False
+            st.replicas = [a for a in st.replicas if a is not victim]
+            # retire through the drain-grace path, NOT an instant kill:
+            # a replica that merely failed a health check under load
+            # (suspected, not proven dead) finishes its in-flight
+            # streams inside the grace window; a truly dead one doesn't
+            # care. Handles stop routing to it at the version bump.
+            st.draining.append(
+                (victim,
+                 time.monotonic() + self._SUSPECT_REAP_GRACE_S))
+            st.version += 1
+            self._cv.notify_all()
+        return True
+
     # -- autoscaling reconcile (reference: autoscaling_state.py:340) ----
     def _reconcile_loop(self) -> None:
         while True:
@@ -394,8 +515,44 @@ class ServeController:
                 if now - ts < 30.0
             }
         for a in ripe:
-            self._kill(a)
+            # drain-aware reap: a retired-on-suspicion replica may still
+            # be serving streams it accepted before (or right after) its
+            # retirement — killing it would violate the mid-stream
+            # contract for requests that did nothing wrong. A busy
+            # replica gets its grace re-armed; only an idle or
+            # unreachable one is killed.
+            busy = False
+            try:
+                stats = ray_tpu.get(a.ongoing_stats.remote(), timeout=3.0)
+                busy = stats.get("ongoing", 0) > 0
+            except Exception:  # noqa: BLE001 — dead/unreachable: reap
+                pass
+            if busy:
+                with self._lock:
+                    st.draining.append((a, now + 10.0))
+            else:
+                self._kill(a)
         auto = st.autoscaling
+        # repair: a replica retired by report_replica_down (node died /
+        # was preempted) is replaced here, below any autoscale delay —
+        # capacity lost to churn comes back as fast as actors start
+        floor = int(auto.get("min_replicas", 1)) if auto \
+            else int(st.spec.get("num_replicas", 1))
+        with self._lock:
+            short = floor - len(st.replicas)
+        if short > 0:
+            new = [self._start_replica(st) for _ in range(short)]
+            try:
+                ray_tpu.get([r.health_check.remote() for r in new],
+                            timeout=300)
+            except Exception:  # noqa: BLE001 — failed starts retried
+                for a in new:  # next reconcile tick; don't publish them
+                    self._kill(a)
+                return
+            with self._lock:
+                st.replicas.extend(new)
+                st.version += 1
+                self._cv.notify_all()
         if not auto:
             return
         target = max(0.1, float(auto.get("target_ongoing_requests", 2.0)))
@@ -497,14 +654,14 @@ def run(app: Application, *, name: Optional[str] = None,
 
 def get_app_handle(name: str) -> DeploymentHandle:
     ctl = _controller()
-    snapshot = ray_tpu.get(ctl.get_deployment.remote(name))
+    snapshot = ray_tpu.get(ctl.get_deployment.remote(name), timeout=60)
     if snapshot is None:
         raise ValueError(f"No deployment named {name!r}")
     return DeploymentHandle(name, ctl, snapshot)
 
 
 def delete(name: str) -> None:
-    ray_tpu.get(_controller().delete.remote(name))
+    ray_tpu.get(_controller().delete.remote(name), timeout=120)
 
 
 def shutdown() -> None:
@@ -526,4 +683,4 @@ def shutdown() -> None:
 
 def status() -> Dict[str, Any]:
     ctl = _controller()
-    return {"deployments": ray_tpu.get(ctl.list_deployments.remote())}
+    return {"deployments": ray_tpu.get(ctl.list_deployments.remote(), timeout=60)}

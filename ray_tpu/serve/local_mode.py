@@ -82,7 +82,10 @@ class LocalDeploymentHandle:
                 threading.Thread(target=self._loop.run_forever,
                                  daemon=True,
                                  name="serve-local-loop").start()
-        return asyncio.run_coroutine_threadsafe(coro, self._loop).result()
+        from ray_tpu.serve import slo
+
+        return slo.result_within_deadline(
+            asyncio.run_coroutine_threadsafe(coro, self._loop))
 
     def _invoke(self, method: str, args, kwargs, model_id: str) -> Any:
         from ray_tpu.serve.multiplex import _current_model_id

@@ -199,11 +199,24 @@ class Tuner:
         max_conc = self._cfg.max_concurrent_trials
         if max_conc is None:
             # fit concurrency to the cluster so trial actors can schedule
-            # (reference: TuneController shares resources across trials)
-            try:
-                cpus = ray_tpu.cluster_resources().get("CPU", 8)
-            except Exception:
-                cpus = 8
+            # (reference: TuneController shares resources across trials).
+            # cluster_resources() races node registration right after
+            # init() and can return {} — sizing off the 8-CPU fallback
+            # then OVERSUBSCRIBES the real cluster and the surplus
+            # trial's launch deadlocks against its finished-but-unkilled
+            # peers until the 180s wait-alive timeout rescues it
+            # (observed: a 6s fit taking 182s). Wait briefly for a real
+            # snapshot before falling back.
+            cpus = 0.0
+            for _ in range(50):
+                try:
+                    cpus = ray_tpu.cluster_resources().get("CPU", 0.0)
+                except Exception:  # noqa: BLE001 — registration race
+                    cpus = 0.0
+                if cpus:
+                    break
+                time.sleep(0.1)
+            cpus = cpus or 8.0
             per_trial = max(self._resources.get("CPU", 1), 0.5)
             max_conc = max(1, min(len(variants), int(cpus / per_trial) - 1 or 1))
         fn_b = dumps_function(self._trainable)
@@ -224,7 +237,13 @@ class Tuner:
                 num_tpus=self._resources.get("TPU", 0),
             ).remote()
             try:
-                ray_tpu.get(actor.start.remote(fn_b, tr.config, checkpoint_path))
+                # bounded: an unplaceable actor must hand control back to
+                # the poll loop (which processes done trials and frees
+                # their resources) instead of parking the controller for
+                # the full 180s actor-resolve window
+                ray_tpu.get(
+                    actor.start.remote(fn_b, tr.config, checkpoint_path),
+                    timeout=30)
             except Exception:
                 # couldn't place the actor (cluster full) — retry later
                 try:

@@ -8,6 +8,13 @@ codebase and must never block:
     (rpc.py runs those on the loop to skip the executor handoff — the
     PR-7 latency contract).
 
+A third sweep covers the **serve/llm request path** (``ray_tpu/serve/``
+and ``ray_tpu/llm/``): every wait there must carry a timeout — the
+front-door SLO contract derives all waits from the per-request deadline
+(serve/slo.py), so an un-timeouted ``.result()`` / ``.get()`` /
+``.wait()`` on the proxy/replica path is a hang under churn waiting to
+happen. Findings carry the ``servepath:`` detail prefix.
+
 Registration sites are resolved by scanning every ``*.register("Name",
 handler, inline=True)`` call; ``self.X`` / bare-name handlers resolve to
 the function def in the same module and are checked transitively (depth
@@ -227,9 +234,74 @@ def _check_reachable(mod: SourceModule, idx: Dict[str, ast.AST],
                     stack.append((callee, depth + 1))
 
 
+_SERVE_PATH_PREFIXES = ("ray_tpu/serve/", "ray_tpu/llm/")
+# resolution calls that park the caller until a result arrives — on the
+# serve request path each must be bounded by the request deadline
+_SERVE_WAIT_ATTRS = {"result", "get", "wait", "acquire"}
+
+
+def _serve_wait_reason(mod: SourceModule,
+                       call: ast.Call) -> Optional[Tuple[str, str]]:
+    """(detail, reason) when this call is an un-timeouted wait on the
+    serve/llm request path."""
+    fn = call.func
+    attr = terminal_attr(fn)
+    if attr not in _SERVE_WAIT_ATTRS or _has_timeout(call):
+        return None
+    if attr == "result":
+        # fut.result(5) / fut.result(timeout) positional counts as bounded
+        if call.args:
+            return None
+        return ("servepath:result", "un-timeouted .result() on the serve "
+                "path — bound it by the request deadline "
+                "(slo.remaining_or(...))")
+    if attr == "get":
+        # only the blocking resolution call ray_tpu.get(...) — dict/queue
+        # .get() shapes are covered by the async-def sweep where relevant
+        if mod.resolves_to(fn, "ray_tpu", "get") and \
+                len(call.args) < 2:  # get(ref, timeout) positional is bounded
+            return ("servepath:get", "un-timeouted ray_tpu.get() on the "
+                    "serve path — bound it by the request deadline")
+        return None
+    if attr == "wait":
+        recv = (receiver_name(fn) or "").lower()
+        # events/conditions parked forever; asyncio.wait & friends exempt
+        if isinstance(fn, ast.Attribute) and not mod.resolves_to(
+                fn, "asyncio", "wait") and "self" != recv:
+            if call.args:  # wait(5) positional timeout
+                return None
+            return ("servepath:wait", "un-timeouted .wait() on the serve "
+                    "path — a dead peer parks this forever; derive a "
+                    "timeout from the request deadline")
+        return None
+    if attr == "acquire":
+        recv = (receiver_name(fn) or "").lower()
+        if ("lock" in recv or "sem" in recv) and not call.args and \
+                call_kwarg(call, "blocking") is None:
+            return ("servepath:acquire", "un-timeouted acquire() on the "
+                    "serve path — bound it or use a with-block outside "
+                    "the request path")
+        return None
+    return None
+
+
+def _check_serve_path(mod: SourceModule, findings: List[Finding]) -> None:
+    if not any(mod.relpath.startswith(p) for p in _SERVE_PATH_PREFIXES):
+        return
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            hit = _serve_wait_reason(mod, node)
+            if hit is not None:
+                findings.append(Finding(
+                    "RC001", mod.relpath, node.lineno, mod.scope_of(node),
+                    hit[1], hit[0]))
+
+
 def check_rc001(modules: List[SourceModule]) -> List[Finding]:
     findings: List[Finding] = []
     for mod in modules:
+        # 0. serve/llm request path: no un-timeouted waits, anywhere
+        _check_serve_path(mod, findings)
         # 1. async def bodies anywhere
         for node in ast.walk(mod.tree):
             if isinstance(node, ast.AsyncFunctionDef):
