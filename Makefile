@@ -4,7 +4,7 @@
 
 PYTHON ?= python3
 
-.PHONY: lint test build asan clean
+.PHONY: lint test build asan tsan clean
 
 lint:
 	$(PYTHON) -m tools.raycheck ray_tpu/ tests/
@@ -28,7 +28,15 @@ asan:
 	$(MAKE) -C src/object_store SANITIZE=asan BUILD_DIR=$(ASAN_STORE_DIR)
 	@echo "ASan fastpath: run with RAY_TPU_FASTPATH_BUILD_DIR=$(ASAN_FASTPATH_DIR)"
 
+TSAN_FASTPATH_DIR := $(CURDIR)/ray_tpu/_private/fastpath/_build_tsan
+TSAN_STORE_DIR := $(CURDIR)/ray_tpu/_private/object_store/_build_tsan
+
+tsan:
+	$(MAKE) -C src/fastpath SANITIZE=tsan PYTHON=$(PYTHON) BUILD_DIR=$(TSAN_FASTPATH_DIR)
+	$(MAKE) -C src/object_store SANITIZE=tsan BUILD_DIR=$(TSAN_STORE_DIR)
+	@echo "TSan fastpath: run with RAY_TPU_FASTPATH_BUILD_DIR=$(TSAN_FASTPATH_DIR)"
+
 clean:
 	$(MAKE) -C src/fastpath clean
 	$(MAKE) -C src/object_store clean
-	rm -rf $(ASAN_FASTPATH_DIR) $(ASAN_STORE_DIR)
+	rm -rf $(ASAN_FASTPATH_DIR) $(ASAN_STORE_DIR) $(TSAN_FASTPATH_DIR) $(TSAN_STORE_DIR)

@@ -51,14 +51,25 @@ def _peak_flops(device) -> float:
 def _run_probe() -> None:
     """Child-process body: quick TPU viability check — backend init plus a
     tiny compiled matmul. Bounds time-to-first-number: a hanging tunnel
-    backend costs one short probe timeout, not a full benchmark timeout."""
-    import jax
-    import jax.numpy as jnp
+    backend costs one short probe timeout, not a full benchmark timeout.
 
-    dev = jax.devices()[0]
-    x = jnp.ones((128, 128), jnp.bfloat16)
-    y = jax.jit(lambda a: a @ a)(x)
-    float(jnp.float32(y[0, 0]))
+    A failure prints ``PROBE_ERR <ExcClass>: <message>`` on stdout so
+    the parent can RECORD the diagnosis (``tpu_probe_error`` in
+    MICROBENCH.json) instead of the old silent ``tpu_probe: failed`` —
+    the ROADMAP-4 blocker was undebuggable from the artifact alone."""
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        dev = jax.devices()[0]
+        x = jnp.ones((128, 128), jnp.bfloat16)
+        y = jax.jit(lambda a: a @ a)(x)
+        float(jnp.float32(y[0, 0]))
+    except BaseException as e:  # noqa: BLE001 — the whole point is to
+        # ship the diagnosis to the parent, whatever it is
+        msg = str(e).replace("\n", " ")[:500]
+        print(f"PROBE_ERR {type(e).__name__}: {msg}")
+        return
     print(f"PROBE_OK platform={dev.platform}")
 
 
@@ -772,10 +783,15 @@ def _run_serve_micro() -> None:
     print("# serve_proxy " + json.dumps(out))
 
 
-def _probe_tpu(max_attempts: int) -> bool:
-    """Short child-process probe; True only on an affirmative TPU
-    verdict. A completed CPU-only probe is authoritative (no retry)."""
+def _probe_tpu(max_attempts: int):
+    """Short child-process probe. Returns ``(ok, error)``: ``ok`` is
+    True only on an affirmative TPU verdict; ``error`` carries the
+    captured exception class + message (or timeout/crash diagnosis)
+    from the LAST failed attempt so the artifact records WHY the probe
+    failed, not just that it did. A completed CPU-only probe is
+    authoritative (no retry)."""
     env = dict(os.environ, **{_CHILD_ENV: "probe"})
+    error = None
     for attempt in range(max_attempts):
         clean_verdict = False
         ok = False
@@ -786,15 +802,38 @@ def _probe_tpu(max_attempts: int) -> bool:
             )
             clean_verdict = "PROBE_OK" in probe.stdout
             ok = clean_verdict and "platform=tpu" in probe.stdout
+            for line in probe.stdout.splitlines():
+                if line.startswith("PROBE_ERR "):
+                    error = line[len("PROBE_ERR "):].strip()
+                    break
+            else:
+                if clean_verdict and not ok:
+                    error = "no TPU device (probe completed on " + (
+                        probe.stdout.split("platform=", 1)[1].split()[0]
+                        if "platform=" in probe.stdout else "?") + ")"
+                elif not clean_verdict:
+                    # child crashed without reaching the guard (OOM
+                    # kill, segfault in a backend lib): last stderr
+                    # line is the best diagnosis available. Overwrite
+                    # unconditionally — the recorded error always
+                    # describes the LAST failed attempt, matching the
+                    # PROBE_ERR and timeout branches.
+                    tail = [ln for ln in probe.stderr.splitlines()
+                            if ln.strip()]
+                    error = ("child exited rc=%d: %s" % (
+                        probe.returncode,
+                        tail[-1][:300] if tail else "no stderr"))
         except subprocess.TimeoutExpired:
             ok = False
+            error = "TimeoutExpired: TPU probe exceeded 240s " \
+                    "(hung backend/tunnel)"
         if ok:
-            return True
+            return True, None
         if clean_verdict:
-            return False  # "no TPU here" is a verdict, not a flake
+            return False, error  # a verdict, not a flake — no retry
         print(f"# TPU probe attempt {attempt + 1} failed/hung",
               file=sys.stderr)
-    return False
+    return False, error
 
 
 _LAST_TPU_PATH = os.path.join(
@@ -866,17 +905,22 @@ def main() -> None:
     # tunnel gets a second chance before the run is stamped CPU-only —
     # and a LAST re-probe runs at the END of the window (after the CPU
     # measurements) before the run settles for a CPU headline.
-    tpu_ok = _probe_tpu(max_attempts=2)
+    tpu_ok, tpu_err = _probe_tpu(max_attempts=2)
     if not tpu_ok:
-        print("# TPU probe found no usable TPU — falling back to CPU; "
-              "results are stamped tpu_probe=failed", file=sys.stderr)
+        print(f"# TPU probe found no usable TPU — falling back to CPU; "
+              f"results are stamped tpu_probe=failed "
+              f"({tpu_err or 'no diagnosis captured'})", file=sys.stderr)
 
     # secondary metrics of record: control-plane ops/s + allreduce GB/s
     # (full detail lands in MICROBENCH.json; compact copies in the tail)
     detail = _secondary_metrics(tpu_ok)
     # a CPU number must never be mistaken for a TPU regression: the
-    # probe verdict rides in the artifact itself
+    # probe verdict rides in the artifact itself — WITH the captured
+    # exception class+message, so a failed probe is debuggable from
+    # MICROBENCH.json alone (ROADMAP item 4 blocker)
     detail["tpu_probe"] = "ok" if tpu_ok else "failed"
+    if not tpu_ok and tpu_err:
+        detail["tpu_probe_error"] = tpu_err
     for key, val in detail.items():
         print(f"# {key} {json.dumps(val)}")
     try:
@@ -913,7 +957,7 @@ def main() -> None:
         # single retry).
         print("# end-of-window TPU re-probe before settling for CPU",
               file=sys.stderr)
-        if _probe_tpu(max_attempts=1):
+        if _probe_tpu(max_attempts=1)[0]:
             line = _try_child("tpu", 1200.0)
             if line is not None:
                 _record_last_tpu(line)

@@ -1936,7 +1936,8 @@ class CoreWorker(CoreRuntime):
             for oid in s.return_ids():
                 self.memory_store.put(oid, ("inline", data))
             self._release_task_refs(s)
-            self._pending_tasks.pop(s.task_id, None)
+            with self._lock:  # vs _claim_push_completion (executor)
+                self._pending_tasks.pop(s.task_id, None)
 
     @staticmethod
     def _batchable(spec: TaskSpec) -> bool:
@@ -2076,7 +2077,8 @@ class CoreWorker(CoreRuntime):
                 if st.get("cancelled"):
                     # don't dispatch; returns already poisoned
                     self._release_task_refs(spec)
-                    self._pending_tasks.pop(spec.task_id, None)
+                    with self._lock:  # vs _claim_push_completion
+                        self._pending_tasks.pop(spec.task_id, None)
                     continue
             live.append(spec)
         if not live:
@@ -2130,7 +2132,8 @@ class CoreWorker(CoreRuntime):
                 for oid in spec.return_ids():
                     self.memory_store.put(oid, ("inline", data))
                 self._release_task_refs(spec)
-                self._pending_tasks.pop(spec.task_id, None)
+                with self._lock:  # vs _claim_push_completion
+                    self._pending_tasks.pop(spec.task_id, None)
             entry.busy = False
             await self._on_lease_idle(sc, entry)
             return
@@ -2388,7 +2391,8 @@ class CoreWorker(CoreRuntime):
                 for oid in spec.return_ids():
                     self.memory_store.put(oid, ("inline", data))
                 self._release_task_refs(spec)
-                st0 = self._pending_tasks.pop(spec.task_id, None)
+                with self._lock:  # vs _claim_push_completion
+                    st0 = self._pending_tasks.pop(spec.task_id, None)
                 if not (st0 or {}).get("cancelled"):
                     self._record_task_event(
                         spec.task_id, spec.function_descriptor.repr_name, "FAILED")
@@ -2403,7 +2407,8 @@ class CoreWorker(CoreRuntime):
                 error=reply.get("stream_error"),
             )
             self._release_task_refs(spec)
-            st0 = self._pending_tasks.pop(spec.task_id, None)
+            with self._lock:  # vs _claim_push_completion (executor)
+                st0 = self._pending_tasks.pop(spec.task_id, None)
             if not (st0 or {}).get("cancelled"):  # cancel() already logged
                 self._record_task_event(
                     spec.task_id, spec.function_descriptor.repr_name,
@@ -2426,7 +2431,8 @@ class CoreWorker(CoreRuntime):
                     self._delete_plasma_copy(
                         oid, ret.get("node_id", self.node_id))
             self._release_task_refs(spec)
-            self._pending_tasks.pop(spec.task_id, None)
+            with self._lock:  # vs _claim_push_completion (executor)
+                self._pending_tasks.pop(spec.task_id, None)
             return
         if reply.get("dropped_borrows"):
             # borrows registered for values that failed to package — the
@@ -2480,7 +2486,8 @@ class CoreWorker(CoreRuntime):
                     self._evict_lineage(oid)
         else:
             self._release_task_refs(spec)
-        st0 = self._pending_tasks.pop(spec.task_id, None)
+        with self._lock:  # vs _claim_push_completion (executor)
+            st0 = self._pending_tasks.pop(spec.task_id, None)
         if not (st0 or {}).get("cancelled"):  # cancel() already logged
             # the worker sets retriable_error on ANY application exception;
             # if it survives to here the retries are exhausted -> FAILED

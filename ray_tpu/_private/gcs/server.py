@@ -652,6 +652,12 @@ class GcsServer:
                 # completion through the watchdog
                 return {"ok": True, "shutdown": True}
             node.alive = True
+            # a stale draining flag (the node died mid-drain and was
+            # force-completed) must not revive the node as DRAINING:
+            # this resurrection is a plain health-check recovery, so it
+            # re-enters ALIVE — DrainNode re-issues a drain if one is
+            # still wanted
+            node.draining = False
             self._node_version += 1
         if draining and not node.draining:
             # a GCS restarted mid-drain relearns the DRAINING state from

@@ -722,9 +722,9 @@ class Raylet:
         # binds to THIS loop (LoopHandle): the SetLeaseContext roundtrip
         # runs in-line on the raylet's own event loop instead of hopping
         # threads to the global client loop and back.
+        wclient = RpcClient(worker.addr[0], worker.addr[1],
+                            self._loop_handle())
         try:
-            wclient = RpcClient(worker.addr[0], worker.addr[1],
-                                self._loop_handle())
             await wclient.acall(
                 "SetLeaseContext",
                 lease_id=lease_id,
@@ -732,11 +732,15 @@ class Raylet:
                 resources=alloc["resources"],
                 timeout=10,
             )
-            wclient.close()
         except Exception as e:  # noqa: BLE001
             logger.warning("failed to set lease context on worker: %s", e)
             self._release_lease(lease, worker_dead=True)
             return None
+        finally:
+            # close on the failure path too — one leaked RpcClient per
+            # failed SetLeaseContext pins a socket and read-loop task
+            # (RC006)
+            wclient.close()
         if req.get("release_cpu_after_grant"):
             # actor with defaulted num_cpus: CPU was only a scheduling
             # requirement — hand it back so long-lived actors don't starve

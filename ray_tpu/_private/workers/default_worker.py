@@ -924,10 +924,14 @@ def main() -> None:
     w.reference_counter.set_on_zero_callback(core.free_object)
     WorkerServer(core, (raylet_host, int(raylet_port)), worker_id)
 
+    # process-lifetime client: the raylet owns this process and the
+    # block-forever wait below never falls through —
+    # raycheck: disable=RC006
     raylet = RpcClient(raylet_host, int(raylet_port), core.loop_thread)
     reply = raylet.call_retrying("RegisterWorker", worker_id=worker_id, addr=core.address)
     if not reply.get("ok"):
         logger.error("raylet rejected registration")
+        raylet.close()
         return
     logger.info("worker %s serving at %s", worker_id[:8], core.address)
 

@@ -176,18 +176,21 @@ class Cluster:
     def wait_for_nodes(self, timeout: float = 30.0) -> None:
         """Block until every added node is registered and alive in the GCS."""
         client = RpcClient("127.0.0.1", self.gcs_port)
-        want = {n.node_id for n in self.nodes}
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            try:
-                infos = client.call("GetAllNodeInfo", timeout=5)
-                alive = {n["NodeID"] for n in infos if n["Alive"]}
-                if want <= alive:
-                    return
-            except Exception:
-                pass
-            time.sleep(0.1)
-        raise TimeoutError(f"nodes did not come up: want {want}")
+        try:
+            want = {n.node_id for n in self.nodes}
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                try:
+                    infos = client.call("GetAllNodeInfo", timeout=5)
+                    alive = {n["NodeID"] for n in infos if n["Alive"]}
+                    if want <= alive:
+                        return
+                except Exception:
+                    pass
+                time.sleep(0.1)
+            raise TimeoutError(f"nodes did not come up: want {want}")
+        finally:
+            client.close()
 
     def shutdown(self) -> None:
         """Tear the cluster down via a short graceful drain, then kill.

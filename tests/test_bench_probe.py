@@ -1,0 +1,117 @@
+"""bench.py TPU-probe failure capture (ISSUE-15 satellite).
+
+The probe used to stamp a bare ``tpu_probe: failed`` into
+MICROBENCH.json with no diagnosis — the ROADMAP item-4 blocker was
+undebuggable from the artifact. These tests pin the capture path:
+the child prints ``PROBE_ERR <cls>: <msg>`` on any exception, and the
+parent records it (plus timeout / hard-crash shapes) as
+``tpu_probe_error``.
+"""
+
+import os
+import subprocess
+import sys
+import types
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+
+class _FakeRun:
+    """Scripted subprocess.run replacement; records call count."""
+
+    def __init__(self, results):
+        self.results = list(results)
+        self.calls = 0
+
+    def __call__(self, *a, **kw):
+        self.calls += 1
+        r = self.results.pop(0)
+        if isinstance(r, Exception):
+            raise r
+        return r
+
+
+def _proc(stdout="", stderr="", rc=0):
+    return subprocess.CompletedProcess(
+        args=["bench"], returncode=rc, stdout=stdout, stderr=stderr)
+
+
+class TestProbeCapture:
+    def test_probe_err_line_is_captured(self, monkeypatch):
+        fake = _FakeRun([
+            _proc(stdout="PROBE_ERR RuntimeError: Unable to initialize "
+                         "backend 'tpu': no TPU platform found\n"),
+        ] * 2)
+        monkeypatch.setattr(bench.subprocess, "run", fake)
+        ok, err = bench._probe_tpu(max_attempts=2)
+        assert ok is False
+        assert err.startswith("RuntimeError: Unable to initialize")
+        assert fake.calls == 2  # an exception is retried (old behavior)
+
+    def test_timeout_is_captured(self, monkeypatch):
+        fake = _FakeRun([
+            subprocess.TimeoutExpired(cmd="bench", timeout=240)] * 2)
+        monkeypatch.setattr(bench.subprocess, "run", fake)
+        ok, err = bench._probe_tpu(max_attempts=2)
+        assert ok is False
+        assert "TimeoutExpired" in err and "240" in err
+
+    def test_hard_crash_records_stderr_tail(self, monkeypatch):
+        fake = _FakeRun([
+            _proc(rc=-11,
+                  stderr="Fatal Python error: Segmentation fault\n"
+                         "Current thread 0x00007f:\n")] * 2)
+        monkeypatch.setattr(bench.subprocess, "run", fake)
+        ok, err = bench._probe_tpu(max_attempts=2)
+        assert ok is False
+        assert "rc=-11" in err and "Current thread" in err
+
+    def test_cpu_verdict_is_authoritative_no_retry(self, monkeypatch):
+        fake = _FakeRun([_proc(stdout="PROBE_OK platform=cpu\n")])
+        monkeypatch.setattr(bench.subprocess, "run", fake)
+        ok, err = bench._probe_tpu(max_attempts=2)
+        assert ok is False
+        assert fake.calls == 1  # clean CPU verdict: no retry
+        assert "no TPU device" in err and "cpu" in err
+
+    def test_tpu_verdict_ok(self, monkeypatch):
+        fake = _FakeRun([_proc(stdout="PROBE_OK platform=tpu\n")])
+        monkeypatch.setattr(bench.subprocess, "run", fake)
+        ok, err = bench._probe_tpu(max_attempts=2)
+        assert ok is True and err is None
+
+
+class TestProbeChild:
+    def test_child_prints_probe_err_on_exception(self, monkeypatch,
+                                                 capsys):
+        """_run_probe must convert ANY backend exception into a
+        parseable PROBE_ERR line instead of a silent crash."""
+        fake_jax = types.ModuleType("jax")
+
+        def _boom():
+            raise RuntimeError("Unable to initialize backend 'tpu': "
+                               "tunnel down")
+
+        fake_jax.devices = _boom
+        fake_jax.numpy = types.ModuleType("jax.numpy")
+        monkeypatch.setitem(sys.modules, "jax", fake_jax)
+        monkeypatch.setitem(sys.modules, "jax.numpy", fake_jax.numpy)
+        bench._run_probe()
+        out = capsys.readouterr().out
+        assert "PROBE_ERR RuntimeError: Unable to initialize" in out
+        assert "PROBE_OK" not in out
+
+    def test_child_end_to_end_cpu(self):
+        """Real child process on this box: a clean CPU verdict."""
+        env = dict(os.environ, **{bench._CHILD_ENV: "probe",
+                                  "JAX_PLATFORMS": "cpu"})
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            env=env, capture_output=True, text=True, timeout=240)
+        assert "PROBE_OK platform=cpu" in r.stdout, \
+            r.stdout[-1000:] + r.stderr[-1000:]

@@ -1,0 +1,140 @@
+"""ThreadSanitizer backstop for the native fastpath extension.
+
+Mirrors tests/test_fastpath_asan.py: rebuilds src/fastpath with ``make
+SANITIZE=tsan`` into a temp dir and re-runs the native/python parity
+suite in a child interpreter with libtsan preloaded and
+``RAY_TPU_FASTPATH=require``. The codec's hot loop releases the GIL
+around payload memcpy (``write_body_into``) — exactly the region where
+a C-level data race (two threads assembling into one buffer, a frame
+reused while a send is in flight) would corrupt a production control
+plane silently. Slow-marked; skips cleanly when the toolchain lacks
+libtsan; a FAILING instrumented build with libtsan present FAILS (the
+Makefile or fastpath.c regressed, not the toolchain).
+
+TSan caveat, handled explicitly: the interpreter itself is not
+instrumented, so TSan cannot see CPython's internal synchronization
+and may emit unrelated reports against python's own allocator. We run
+with ``halt_on_error=0`` + ``exitcode=0`` so those do not abort the
+suite, then fail ONLY on reports that implicate the fastpath extension
+(its .so or source file appears in the report block) — a real race in
+our C code still fails CI, interpreter noise does not.
+"""
+
+import os
+import re
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC_DIR = os.path.join(REPO, "src", "fastpath")
+
+pytestmark = pytest.mark.slow
+
+
+def _libtsan(cc: str):
+    try:
+        out = subprocess.run(
+            [cc, "-print-file-name=libtsan.so"],
+            capture_output=True, text=True, timeout=30, check=True,
+        ).stdout.strip()
+    except (OSError, subprocess.SubprocessError):
+        return None
+    # an unresolved -print-file-name echoes the bare name back
+    if out and os.path.sep in out and os.path.exists(out):
+        return out
+    return None
+
+
+def _fastpath_reports(output: str):
+    """TSan report blocks that implicate the fastpath extension."""
+    blocks = re.split(r"(?=WARNING: ThreadSanitizer)", output)
+    return [b for b in blocks
+            if b.startswith("WARNING: ThreadSanitizer")
+            and ("fastpath" in b or "ray_tpu_fastpath" in b)]
+
+
+def test_fastpath_parity_under_tsan(tmp_path):
+    cc = os.environ.get("CC") or "gcc"
+    if shutil.which(cc) is None:
+        pytest.skip(f"no C compiler ({cc}) on PATH")
+    libtsan = _libtsan(cc)
+    if libtsan is None:
+        pytest.skip(f"{cc} lacks libtsan (-print-file-name=libtsan.so "
+                    f"unresolved) — install the TSan runtime to run this")
+
+    build_dir = str(tmp_path / "tsan_build")
+    built = subprocess.run(
+        ["make", "-C", SRC_DIR, "SANITIZE=tsan",
+         f"PYTHON={sys.executable}", f"BUILD_DIR={build_dir}"],
+        capture_output=True, text=True, timeout=300,
+    )
+    # libtsan is confirmed present: a failing instrumented build is a
+    # real regression — fail, don't skip
+    assert built.returncode == 0, \
+        f"make SANITIZE=tsan failed:\n{built.stderr[-2000:]}"
+
+    env = dict(os.environ)
+    env.update({
+        # libtsan must be loaded before the (uninstrumented) interpreter
+        "LD_PRELOAD": libtsan,
+        # don't abort on reports (the interpreter is uninstrumented and
+        # can trip false positives); we grep for fastpath-implicating
+        # reports below instead
+        "TSAN_OPTIONS": "halt_on_error=0:exitcode=0:"
+                        "report_thread_leaks=0:report_signal_unsafe=0:"
+                        "allocator_may_return_null=1",
+        "RAY_TPU_FASTPATH": "require",
+        "RAY_TPU_FASTPATH_BUILD_DIR": build_dir,
+        "JAX_PLATFORMS": "cpu",
+    })
+    run = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+         os.path.join(REPO, "tests", "test_fastpath_parity.py")],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO,
+    )
+    combined = run.stdout + "\n" + run.stderr
+    tail = combined[-4000:]
+    assert run.returncode == 0, \
+        f"parity suite failed under TSan (rc={run.returncode}):\n{tail}"
+    bad = _fastpath_reports(combined)
+    assert not bad, \
+        "ThreadSanitizer reported a race in the fastpath extension:\n" \
+        + bad[0][:4000]
+
+
+def test_sanitize_flag_still_rejects_unknown():
+    out = subprocess.run(
+        ["make", "-C", SRC_DIR, "SANITIZE=bogus", "-n"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode != 0 and "unknown SANITIZE" in out.stderr
+
+
+def test_object_store_tsan_target_builds(tmp_path):
+    """The store daemon's tsan build must at least compile+link —
+    cheap (one TU) and catches Makefile drift for the second native
+    extension named by the satellite."""
+    cxx = os.environ.get("CXX") or "g++"
+    if shutil.which(cxx) is None:
+        pytest.skip(f"no C++ compiler ({cxx}) on PATH")
+    try:
+        out = subprocess.run(
+            [cxx, "-print-file-name=libtsan.so"],
+            capture_output=True, text=True, timeout=30, check=True,
+        ).stdout.strip()
+    except (OSError, subprocess.SubprocessError):
+        pytest.skip("cannot query libtsan")
+    if not (out and os.path.sep in out and os.path.exists(out)):
+        pytest.skip(f"{cxx} lacks libtsan")
+    build_dir = str(tmp_path / "store_tsan")
+    built = subprocess.run(
+        ["make", "-C", os.path.join(REPO, "src", "object_store"),
+         "SANITIZE=tsan", f"BUILD_DIR={build_dir}"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert built.returncode == 0, \
+        f"object_store make SANITIZE=tsan failed:\n{built.stderr[-2000:]}"
+    assert os.path.exists(os.path.join(build_dir, "ray_tpu_store"))

@@ -689,11 +689,687 @@ class TestRC001CollectiveV2:
 
 
 # =====================================================================
+# RC006 — resource lifecycle (CFG path-sensitive acquire/release)
+# =====================================================================
+
+class TestRC006:
+    def test_early_return_leaks_lock(self, tmp_path):
+        fs = _scan(tmp_path, "ray_tpu/m.py", """
+            def f(cond):
+                self_lock.acquire()
+                if cond:
+                    return 1
+                self_lock.release()
+                return 2
+        """, rules=["RC006"])
+        assert _details(fs) == [("RC006", "unreleased:self_lock")]
+
+    def test_exception_path_leaks_lock(self, tmp_path):
+        # work() raising escapes the function with the lock held: the
+        # CFG's exception edges catch the path a happy-path reviewer
+        # doesn't see
+        fs = _scan(tmp_path, "ray_tpu/m.py", """
+            def f():
+                my_lock.acquire()
+                work()
+                my_lock.release()
+        """, rules=["RC006"])
+        assert _details(fs) == [("RC006", "unreleased:my_lock")]
+
+    def test_try_finally_release_is_clean(self, tmp_path):
+        fs = _scan(tmp_path, "ray_tpu/m.py", """
+            def f():
+                my_lock.acquire()
+                try:
+                    work()
+                finally:
+                    my_lock.release()
+        """, rules=["RC006"])
+        assert fs == []
+
+    def test_while_true_has_no_fallthrough_exit(self, tmp_path):
+        # `while True:` only exits via break/return/raise — the cond
+        # node must not fabricate a normal fall-through path that
+        # "leaks" the lock the in-loop return correctly releases
+        # (review finding)
+        fs = _scan(tmp_path, "ray_tpu/m.py", """
+            def f(flag):
+                my_lock.acquire()
+                while True:
+                    if flag:
+                        my_lock.release()
+                        return
+        """, rules=["RC006"])
+        assert fs == []
+
+    def test_break_routes_through_finally(self, tmp_path):
+        # a break out of a try/finally still runs the finally: code
+        # that releases there is CORRECT and must not be flagged
+        # (review finding: break/continue used to bypass finallys)
+        fs = _scan(tmp_path, "ray_tpu/m.py", """
+            def f(items):
+                for it in items:
+                    my_lock.acquire()
+                    try:
+                        if work(it):
+                            break
+                    finally:
+                        my_lock.release()
+        """, rules=["RC006"])
+        assert fs == []
+
+    def test_unclosed_client_on_success_path(self, tmp_path):
+        fs = _scan(tmp_path, "ray_tpu/m.py", """
+            def f(addr):
+                c = RpcClient(addr)
+                return c.call("Ping")
+        """, rules=["RC006"])
+        assert _details(fs) == [("RC006", "unclosed:c")]
+
+    def test_closed_client_is_clean(self, tmp_path):
+        fs = _scan(tmp_path, "ray_tpu/m.py", """
+            def f(addr):
+                c = RpcClient(addr)
+                try:
+                    return c.call("Ping")
+                finally:
+                    c.close()
+        """, rules=["RC006"])
+        assert fs == []
+
+    def test_escaped_client_is_callers_problem(self, tmp_path):
+        fs = _scan(tmp_path, "ray_tpu/m.py", """
+            def f(self, addr):
+                c = RpcClient(addr)
+                self._clients[addr] = c
+                return c
+        """, rules=["RC006"])
+        assert fs == []
+
+    def test_nondaemon_thread_must_join(self, tmp_path):
+        fs = _scan(tmp_path, "ray_tpu/m.py", """
+            import threading
+
+            def f():
+                t = threading.Thread(target=work, daemon=False)
+                t.start()
+        """, rules=["RC006"])
+        assert _details(fs) == [("RC006", "unjoined:t")]
+
+    def test_joined_thread_is_clean(self, tmp_path):
+        fs = _scan(tmp_path, "ray_tpu/m.py", """
+            import threading
+
+            def f():
+                t = threading.Thread(target=work, daemon=False)
+                t.start()
+                t.join(timeout=5)
+        """, rules=["RC006"])
+        assert fs == []
+
+    def test_handles_not_tracked_in_tests_tree(self, tmp_path):
+        # test fixtures park cleanup in finalizers the analysis can't
+        # see — handle tracking is runtime-tree only
+        fs = _scan(tmp_path, "tests/test_x.py", """
+            def f(addr):
+                c = RpcClient(addr)
+                return c.call("Ping")
+        """, rules=["RC006"])
+        assert fs == []
+
+    def test_suppression(self, tmp_path):
+        fs = _scan(tmp_path, "ray_tpu/m.py", """
+            def f(addr):
+                # process-lifetime client — raycheck: disable=RC006
+                c = RpcClient(addr)
+                return c.call("Ping")
+        """, rules=["RC006"])
+        assert fs == []
+
+
+# =====================================================================
+# RC007 — static lockset race detection
+# =====================================================================
+
+class TestRC007:
+    SCOPED = "ray_tpu/_private/memory_store.py"
+
+    def test_cross_context_rmw_without_lock(self, tmp_path):
+        """io-loop RMW vs thread-context RMW on the same attr, no
+        common lock: the Eraser shape."""
+        fs = _scan(tmp_path, self.SCOPED, """
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self._t = threading.Thread(
+                        target=self._drain, daemon=True)
+
+                async def put(self, x):
+                    self.items.append(x)
+
+                def _drain(self):
+                    self.items.pop()
+        """, rules=["RC007"])
+        assert ("RC007", "race:items") in _details(fs)
+
+    def test_common_lock_is_clean(self, tmp_path):
+        fs = _scan(tmp_path, self.SCOPED, """
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._t = threading.Thread(
+                        target=self._drain, daemon=True)
+
+                async def put(self, x):
+                    with self._lock:
+                        self.items.append(x)
+
+                def _drain(self):
+                    with self._lock:
+                        self.items.pop()
+        """, rules=["RC007"])
+        assert fs == []
+
+    def test_inconsistent_discipline_flagged(self, tmp_path):
+        """One side locks, a cross-context WRITE doesn't: half-locked
+        state is the PR-7/PR-8 bug family."""
+        fs = _scan(tmp_path, self.SCOPED, """
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._t = threading.Thread(
+                        target=self._drain, daemon=True)
+
+                async def put(self, x):
+                    self.items = x
+
+                def _drain(self):
+                    with self._lock:
+                        return self.items
+        """, rules=["RC007"])
+        assert ("RC007", "race:items") in _details(fs)
+
+    def test_same_context_not_flagged(self, tmp_path):
+        # two io-loop coroutines interleave only at awaits: dict/list
+        # ops between them are loop-serialized
+        fs = _scan(tmp_path, self.SCOPED, """
+            class Store:
+                async def put(self, x):
+                    self.items.append(x)
+
+                async def take(self):
+                    return self.items.pop()
+        """, rules=["RC007"])
+        assert fs == []
+
+    def test_init_writes_are_construction(self, tmp_path):
+        fs = _scan(tmp_path, self.SCOPED, """
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self.items = []
+                    self._t = threading.Thread(
+                        target=self._drain, daemon=True)
+
+                def _drain(self):
+                    self.items.pop()
+        """, rules=["RC007"])
+        assert fs == []
+
+    def test_synced_types_are_exempt(self, tmp_path):
+        # Queue/deque/Lock-valued attrs synchronize themselves
+        fs = _scan(tmp_path, self.SCOPED, """
+            import collections
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self.q = collections.deque()
+                    self._t = threading.Thread(
+                        target=self._drain, daemon=True)
+
+                async def put(self, x):
+                    self.q.append(x)
+
+                def _drain(self):
+                    self.q.popleft()
+        """, rules=["RC007"])
+        assert fs == []
+
+    def test_out_of_scope_module_not_scanned(self, tmp_path):
+        fs = _scan(tmp_path, "ray_tpu/util/thing.py", """
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self._t = threading.Thread(
+                        target=self._drain, daemon=True)
+
+                async def put(self, x):
+                    self.items.append(x)
+
+                def _drain(self):
+                    self.items.pop()
+        """, rules=["RC007"])
+        assert fs == []
+
+    def test_suppression(self, tmp_path):
+        fs = _scan(tmp_path, self.SCOPED, """
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self._t = threading.Thread(
+                        target=self._drain, daemon=True)
+
+                async def put(self, x):
+                    # single-writer by design — raycheck: disable=RC007
+                    self.items.append(x)
+
+                def _drain(self):
+                    self.items.pop()
+        """, rules=["RC007"])
+        assert _details(fs) == [("RC007", "race:items")]  # _drain side
+        assert fs[0].scope == "Store._drain"
+
+
+# =====================================================================
+# RC008 — protocol conformance (checked-in transition tables)
+# =====================================================================
+
+class TestRC008:
+    GCS = "ray_tpu/_private/gcs/server.py"
+
+    def test_unknown_state_typo(self, tmp_path):
+        fs = _scan(tmp_path, self.GCS, """
+            def check(actor):
+                if actor.state == "ALVIE":
+                    return 1
+        """, rules=["RC008"])
+        assert _details(fs) == [("RC008", "unknown-state:ALVIE")]
+
+    def test_illegal_transition_dead_to_alive_actor(self, tmp_path):
+        # DEAD is terminal for actors: a killed actor must never be
+        # resurrected by a late registration
+        fs = _scan(tmp_path, self.GCS, """
+            def revive(actor):
+                if actor.state == "DEAD":
+                    actor.state = "ALIVE"
+        """, rules=["RC008"])
+        assert _details(fs) == [("RC008", "illegal:DEAD->ALIVE")]
+
+    def test_legal_transition_clean(self, tmp_path):
+        fs = _scan(tmp_path, self.GCS, """
+            def promote(actor):
+                if actor.state == "PENDING":
+                    actor.state = "ALIVE"
+
+            def fail(actor):
+                if actor.state == "ALIVE":
+                    actor.state = "RESTARTING"
+        """, rules=["RC008"])
+        assert fs == []
+
+    def test_unknown_pre_state_not_flagged(self, tmp_path):
+        # no dominating guard: the pre-state is the callers' contract
+        fs = _scan(tmp_path, self.GCS, """
+            def kill(actor):
+                actor.state = "DEAD"
+        """, rules=["RC008"])
+        assert fs == []
+
+    def test_early_terminal_guard_establishes_fact(self, tmp_path):
+        # `if actor.state != "PENDING": return` pins PENDING afterwards
+        fs = _scan(tmp_path, self.GCS, """
+            def promote(actor):
+                if actor.state != "PENDING":
+                    return
+                actor.state = "ALIVE"
+
+            def bad(actor):
+                if actor.state != "DEAD":
+                    return
+                actor.state = "ALIVE"
+        """, rules=["RC008"])
+        assert _details(fs) == [("RC008", "illegal:DEAD->ALIVE")]
+
+    def test_heartbeat_resurrection_shape(self, tmp_path):
+        """The PR-8 bug, reduced: reviving a dead node without testing
+        the heartbeat's draining flag is the resurrection bug; with the
+        guard it is a legal health-check recovery."""
+        fs = _scan(tmp_path, self.GCS, """
+            async def heartbeat_bad(self, node, draining=False):
+                if not node.alive:
+                    node.alive = True
+                    node.draining = False
+
+            async def heartbeat_good(self, node, draining=False):
+                if not node.alive:
+                    if draining:
+                        return {"ok": True, "shutdown": True}
+                    node.alive = True
+                    node.draining = False
+        """, rules=["RC008"])
+        assert _details(fs) == [("RC008", "unguarded:DEAD->ALIVE")]
+        assert fs[0].scope == "heartbeat_bad"
+
+    def test_assignment_invalidates_stale_facts(self, tmp_path):
+        """After `actor.state = "DEAD"` the earlier `== "PENDING"` fact
+        is stale: the second assignment is DEAD->ALIVE (illegal), not
+        PENDING->ALIVE (review finding: facts used to survive the
+        assignment, hiding the violation)."""
+        fs = _scan(tmp_path, self.GCS, """
+            def flow(actor):
+                if actor.state == "PENDING":
+                    actor.state = "DEAD"
+                    notify(actor)
+                    actor.state = "ALIVE"
+        """, rules=["RC008"])
+        assert _details(fs) == [("RC008", "illegal:DEAD->ALIVE")]
+
+    def test_raylet_never_undrains(self, tmp_path):
+        fs = _scan(tmp_path, "ray_tpu/_private/raylet/raylet.py", """
+            class Raylet:
+                def __init__(self):
+                    self.draining = False
+
+                def oops(self):
+                    if self.draining:
+                        self.draining = False
+        """, rules=["RC008"])
+        assert _details(fs) == [("RC008", "illegal:DRAINING->RUNNING")]
+
+    def test_lease_warmth_never_revoked(self, tmp_path):
+        fs = _scan(tmp_path, "ray_tpu/_private/core_worker.py", """
+            def chill(entry):
+                if entry.warm:
+                    if entry.busy:
+                        entry.warm = False
+        """, rules=["RC008"])
+        assert _details(fs) == [("RC008", "illegal:BUSY_WARM->BUSY_COLD")]
+
+    def test_suppression(self, tmp_path):
+        fs = _scan(tmp_path, self.GCS, """
+            def revive(actor):
+                if actor.state == "DEAD":
+                    actor.state = "ALIVE"  # raycheck: disable=RC008
+        """, rules=["RC008"])
+        assert fs == []
+
+
+# =====================================================================
+# interprocedural RC001 — whole-program reachability (v2 tentpole)
+# =====================================================================
+
+class TestRC001Interprocedural:
+    def test_cross_module_reachability(self, tmp_path):
+        """v1's same-module depth-3 walk could not see this: the inline
+        handler's blocking sleep lives two modules away."""
+        (tmp_path / "helpers.py").write_text(textwrap.dedent("""
+            import time
+
+            def deep_wait():
+                time.sleep(0.2)
+        """))
+        (tmp_path / "middle.py").write_text(textwrap.dedent("""
+            from helpers import deep_wait
+
+            def relay():
+                deep_wait()
+        """))
+        (tmp_path / "server.py").write_text(textwrap.dedent("""
+            from middle import relay
+
+            class S:
+                def __init__(self, srv):
+                    srv.register("Q", self._q, inline=True)
+
+                def _q(self):
+                    relay()
+        """))
+        from tools.raycheck.rules import analyze as _an, \
+            load_modules as _lm
+        mods = _lm([str(tmp_path)], root=str(tmp_path))
+        fs = _an(mods, rules=["RC001"])
+        assert ("RC001", "inline:time.sleep") in _details(fs)
+        [f] = [f for f in fs if f.detail == "inline:time.sleep"]
+        assert f.path == "helpers.py"
+        # the finding carries the whole call chain for --json/CI
+        assert list(f.chain) == ["S._q", "relay", "deep_wait"]
+
+    def test_depth_beyond_three_still_caught(self, tmp_path):
+        """v1 cut reachability at depth 3; v2 is unbounded — the old
+        finding set is a strict subset of the new one."""
+        src = textwrap.dedent("""
+            import time
+
+            class S:
+                def __init__(self, srv):
+                    srv.register("Q", self._q, inline=True)
+
+                def _q(self):
+                    hop0()
+        """)
+        src += "\n".join(
+            f"\ndef hop{i}():\n    hop{i + 1}()\n" for i in range(6))
+        src += "\ndef hop6():\n    time.sleep(1)\n"
+        p = tmp_path / "mod.py"
+        p.write_text(src)
+        mods = load_modules([str(tmp_path)], root=str(tmp_path))
+        fs = analyze(mods, rules=["RC001"])
+        assert ("RC001", "inline:time.sleep") in _details(fs)
+        [f] = [f for f in fs if f.detail == "inline:time.sleep"]
+        assert list(f.chain) == \
+            ["S._q"] + [f"hop{i}" for i in range(7)]
+
+
+# =====================================================================
+# regression guards — the two shipped bugs must stay lintable
+# =====================================================================
+
+class TestRegressionGuards:
+    def test_deleting_pr8_heartbeat_guard_fails_lint(self, tmp_path):
+        """Acceptance criterion: textually delete the PR-8
+        drain-completion guard from the REAL gcs/server.py and RC008
+        must fail the lint."""
+        real = os.path.join(REPO, "ray_tpu", "_private", "gcs",
+                            "server.py")
+        src = open(real).read()
+        import re as _re
+        cut = _re.sub(
+            r"\n +if draining:\n( +#[^\n]*\n)* +return "
+            r"\{\"ok\": True, \"shutdown\": True\}\n",
+            "\n", src, count=1)
+        assert cut != src, \
+            "heartbeat guard not found — did Heartbeat get refactored?"
+        p = tmp_path / "ray_tpu" / "_private" / "gcs" / "server.py"
+        p.parent.mkdir(parents=True)
+        p.write_text(cut)
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.raycheck", str(p),
+             "--no-baseline", "--no-cache", "--rules", "RC008"],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert r.returncode == 1 and "RC008" in r.stdout and \
+            "resurrection" in r.stdout, r.stdout + r.stderr
+        # and the UNMODIFIED file stays clean
+        r2 = subprocess.run(
+            [sys.executable, "-m", "tools.raycheck", real,
+             "--no-baseline", "--no-cache", "--rules", "RC008"],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert r2.returncode == 0, r2.stdout + r2.stderr
+
+    def test_reintroducing_pr7_lock_held_teardown_fails_lint(
+            self, tmp_path):
+        """Acceptance criterion: the PR-7 livelock shape (closing
+        clients while holding the module lock the io loop needs) must
+        exit non-zero."""
+        p = tmp_path / "_private" / "mod.py"
+        p.parent.mkdir(parents=True)
+        p.write_text(textwrap.dedent("""
+            import threading
+
+            _client_lock = threading.Lock()
+            _clients = {}
+
+            def clear_client_cache():
+                with _client_lock:
+                    for c in _clients.values():
+                        c.close()
+                    _clients.clear()
+        """))
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.raycheck", str(p),
+             "--no-baseline", "--no-cache"],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert r.returncode == 1 and "RC002" in r.stdout, \
+            r.stdout + r.stderr
+
+
+# =====================================================================
+# cache + CLI --json + wall clock
+# =====================================================================
+
+class TestCache:
+    def test_cache_hit_identical_findings(self, tmp_path):
+        """Satellite acceptance: a cache hit must produce findings
+        byte-identical to a cold run."""
+        src_dir = tmp_path / "src"
+        src_dir.mkdir()
+        (src_dir / "mod.py").write_text(textwrap.dedent("""
+            import time
+
+            async def handler():
+                time.sleep(1)
+
+            def leak(cond):
+                a_lock.acquire()
+                if cond:
+                    return
+                a_lock.release()
+        """))
+        from tools.raycheck import analyze_paths
+        n_cold, cold = analyze_paths([str(src_dir)],
+                                     root=str(tmp_path), use_cache=False)
+        n_w1, warm1 = analyze_paths([str(src_dir)],
+                                    root=str(tmp_path), use_cache=True)
+        n_w2, warm2 = analyze_paths([str(src_dir)],
+                                    root=str(tmp_path), use_cache=True)
+        assert (tmp_path / ".raycheck_cache").is_dir()
+        for warm in (warm1, warm2):
+            assert [f.as_json() for f in warm] == \
+                [f.as_json() for f in cold]
+        assert n_cold == n_w1 == n_w2
+
+    def test_file_count_stable_with_unparseable_file(self, tmp_path):
+        # a syntax-error file is skipped by the analysis; the reported
+        # file count must be identical on cold, cache-miss and
+        # cache-hit runs (review finding: the hit path used to count
+        # raw inputs, not parsed ones)
+        src_dir = tmp_path / "src"
+        src_dir.mkdir()
+        (src_dir / "ok.py").write_text("def f():\n    return 1\n")
+        (src_dir / "broken.py").write_text("def f(:\n")
+        from tools.raycheck import analyze_paths
+        n_cold, _ = analyze_paths([str(src_dir)], root=str(tmp_path),
+                                  use_cache=False)
+        n_miss, _ = analyze_paths([str(src_dir)], root=str(tmp_path),
+                                  use_cache=True)
+        n_hit, _ = analyze_paths([str(src_dir)], root=str(tmp_path),
+                                 use_cache=True)
+        assert n_cold == n_miss == n_hit == 1
+
+    def test_edit_invalidates(self, tmp_path):
+        src_dir = tmp_path / "src"
+        src_dir.mkdir()
+        p = src_dir / "mod.py"
+        p.write_text("async def h():\n    return 1\n")
+        from tools.raycheck import analyze_paths
+        _, fs = analyze_paths([str(src_dir)], root=str(tmp_path),
+                              use_cache=True)
+        assert fs == []
+        p.write_text("import time\n\nasync def h():\n    time.sleep(1)\n")
+        _, fs2 = analyze_paths([str(src_dir)], root=str(tmp_path),
+                               use_cache=True)
+        assert [f.detail for f in fs2] == ["async:time.sleep"]
+
+    def test_warm_lint_wall_clock_budget(self):
+        """Acceptance: warm-cache `make lint` ≤ 30 s on this box (it
+        runs in well under 10; the margin absorbs CI noise)."""
+        import time as _time
+        cmd = [sys.executable, "-m", "tools.raycheck",
+               "ray_tpu/", "tests/", "-q"]
+        subprocess.run(cmd, capture_output=True, cwd=REPO, timeout=120)
+        t0 = _time.monotonic()
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           cwd=REPO, timeout=120)
+        dt = _time.monotonic() - t0
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert dt <= 30.0, f"warm `make lint` took {dt:.1f}s (> 30s)"
+
+
+class TestJsonOutput:
+    def test_json_findings_schema(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(textwrap.dedent("""
+            import time
+
+            class S:
+                def __init__(self, srv):
+                    srv.register("Q", self._q, inline=True)
+
+                def _q(self):
+                    self._helper()
+
+                def _helper(self):
+                    time.sleep(1)
+        """))
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.raycheck", str(bad),
+             "--no-baseline", "--no-cache", "--json",
+             "--rules", "RC001"],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert r.returncode == 1, r.stdout + r.stderr
+        doc = json.loads(r.stdout)
+        assert doc["files"] == 1 and doc["stale_baseline"] == []
+        [f] = doc["findings"]
+        assert f["rule"] == "RC001"
+        assert f["fingerprint"].startswith("RC001|")
+        assert f["line"] > 0 and f["path"].endswith("bad.py")
+        # the interprocedural context chain rides along for CI diffing
+        assert f["chain"] == ["S._q", "S._helper"]
+
+    def test_json_clean_exit_zero(self, tmp_path):
+        ok = tmp_path / "ok.py"
+        ok.write_text("def f():\n    return 1\n")
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.raycheck", str(ok),
+             "--no-baseline", "--no-cache", "--json"],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert r.returncode == 0
+        doc = json.loads(r.stdout)
+        assert doc["findings"] == []
+
+
+# =====================================================================
 # live tree + CLI — the tier-1 enforcement point
 # =====================================================================
 
 class TestLiveTree:
     def test_live_tree_is_clean(self):
+        """Zero non-baselined findings across ALL rules — including the
+        v2 interprocedural ones (RC006/RC007/RC008), which run by
+        default and whose genuine pre-PR findings were FIXED, not
+        baselined."""
+        from tools.raycheck.rules import RULE_DOCS, builtin_rules
+        assert set(builtin_rules()) == set(RULE_DOCS) and \
+            {"RC006", "RC007", "RC008"} <= set(RULE_DOCS), \
+            "the interprocedural rules must be registered by default"
         new, _old, stale = run(
             [os.path.join(REPO, "ray_tpu"), os.path.join(REPO, "tests")],
             baseline_path=os.path.join(REPO, "tools", "raycheck",

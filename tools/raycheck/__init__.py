@@ -10,6 +10,7 @@ tools/raycheck/README.md for each rule with real before/after examples.
 
 from __future__ import annotations
 
+import os
 from typing import List, Optional, Tuple
 
 from tools.raycheck import baseline as baseline_mod
@@ -18,19 +19,61 @@ from tools.raycheck.rules import (  # noqa: F401 — public API
     RULE_DOCS,
     SourceModule,
     analyze,
+    discover_files,
     load_modules,
 )
 
 
+def analyze_paths(paths: List[str], root: Optional[str] = None,
+                  rules: Optional[List[str]] = None,
+                  use_cache: bool = False,
+                  ) -> Tuple[int, List[Finding]]:
+    """Discover + load + analyze, with the two-layer content-hash
+    cache when ``use_cache``: an unchanged input set returns the
+    memoised findings without running any analysis (run-level cache);
+    otherwise unchanged files at least skip parse/annotate (per-file
+    cache). Returns (file_count, findings)."""
+    root = root or os.getcwd()
+    key = None
+    contents = None
+    if use_cache:
+        from tools.raycheck import cache as cache_mod
+        # read every input ONCE: the same bytes feed the run key and
+        # the analysis (no TOCTOU window between digesting and parsing)
+        contents = {}
+        digests = []
+        for f in discover_files(paths):
+            try:
+                with open(f, "rb") as fh:
+                    raw = fh.read()
+            except OSError:
+                continue
+            contents[f] = raw
+            digests.append((os.path.relpath(f, root).replace(os.sep, "/"),
+                            cache_mod.digest(raw)))
+        key = cache_mod.run_key(digests, rules)
+        cached = cache_mod.get_run(root, key)
+        if cached is not None:
+            return cached
+    modules = load_modules(paths, root=root, use_cache=use_cache,
+                           contents=contents)
+    findings = analyze(modules, rules=rules)
+    if use_cache and key is not None and modules:
+        from tools.raycheck import cache as cache_mod
+        cache_mod.put_run(root, key, len(modules), findings)
+    return len(modules), findings
+
+
 def run(paths: List[str], baseline_path: Optional[str] = None,
         rules: Optional[List[str]] = None, root: Optional[str] = None,
+        use_cache: bool = False,
         ) -> Tuple[List[Finding], List[Finding], List[str]]:
     """Programmatic entry point (tests use this).
 
     Returns (new_findings, grandfathered_findings, stale_fingerprints).
     Exit-status contract: non-empty ``new_findings`` means fail.
     """
-    modules = load_modules(paths, root=root)
-    findings = analyze(modules, rules=rules)
+    _n, findings = analyze_paths(paths, root=root, rules=rules,
+                                 use_cache=use_cache)
     base = baseline_mod.load(baseline_path) if baseline_path else {}
     return baseline_mod.apply(findings, base)

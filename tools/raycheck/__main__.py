@@ -7,12 +7,13 @@ Exit codes: 0 = clean (every finding suppressed or baselined),
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 from collections import Counter
 from typing import List
 
 from tools.raycheck import baseline as baseline_mod
-from tools.raycheck.rules import RULE_DOCS, analyze, load_modules
+from tools.raycheck.rules import RULE_DOCS
 
 
 def main(argv: List[str] = None) -> int:
@@ -30,6 +31,14 @@ def main(argv: List[str] = None) -> int:
                     help="ignore the baseline: report everything")
     ap.add_argument("--write-baseline", action="store_true",
                     help="grandfather every current finding and exit 0")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="skip the .raycheck_cache/ content-hash cache "
+                         "(cold parse of every file)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output: one JSON document "
+                         "with rule/fingerprint/path/line/chain per "
+                         "finding (stable across line drift via the "
+                         "fingerprint) for CI diffing")
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="print only the summary line")
@@ -48,12 +57,14 @@ def main(argv: List[str] = None) -> int:
             print(f"unknown rule(s): {', '.join(unknown)}", file=sys.stderr)
             return 2
 
+    from tools.raycheck import analyze_paths
+
     paths = args.paths or ["ray_tpu/", "tests/"]
-    modules = load_modules(paths)
-    if not modules:
+    nfiles, findings = analyze_paths(paths, rules=rules,
+                                     use_cache=not args.no_cache)
+    if not nfiles:
         print(f"no python files under: {' '.join(paths)}", file=sys.stderr)
         return 2
-    findings = analyze(modules, rules=rules)
 
     if args.write_baseline:
         baseline_mod.save(args.baseline, findings)
@@ -64,6 +75,15 @@ def main(argv: List[str] = None) -> int:
     base = Counter() if args.no_baseline else baseline_mod.load(args.baseline)
     new, old, stale = baseline_mod.apply(findings, base)
 
+    if args.as_json:
+        print(json.dumps({
+            "files": nfiles,
+            "findings": [f.as_json() for f in new],
+            "baselined": [f.as_json() for f in old],
+            "stale_baseline": list(stale),
+        }, indent=1, sort_keys=True))
+        return 1 if new else 0
+
     if not args.quiet:
         for f in new:
             print(f.render())
@@ -72,7 +92,7 @@ def main(argv: List[str] = None) -> int:
                   f"{fp}")
     per_rule = Counter(f.rule for f in new)
     detail = ", ".join(f"{r}:{n}" for r, n in sorted(per_rule.items()))
-    print(f"raycheck: {len(modules)} files, {len(new)} new finding(s)"
+    print(f"raycheck: {nfiles} files, {len(new)} new finding(s)"
           + (f" ({detail})" if detail else "")
           + (f", {len(old)} baselined" if old else "")
           + (f", {len(stale)} stale baseline entr(y/ies)" if stale else ""))

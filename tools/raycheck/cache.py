@@ -1,0 +1,177 @@
+"""Content-hash analysis cache for raycheck.
+
+Parsing + annotating ~200 files (scope maps, import tables, suppression
+maps) dominates a warm raycheck run now that the rules themselves are
+summary walks. Each :class:`~tools.raycheck.rules.SourceModule` is
+pickled under ``.raycheck_cache/`` keyed by
+
+    sha256(engine_fingerprint || relpath || file_bytes)
+
+where ``engine_fingerprint`` hashes every ``tools/raycheck/*.py``
+source — ANY edit to the analyzer invalidates the whole cache, so a
+cache hit is byte-for-byte equivalent to a cold parse (asserted by
+``tests/test_raycheck.py::TestCache``). The cross-file phases (call
+graph, lock graph, RPC contract) always run fresh on the loaded
+modules; only the per-file construction is memoised.
+
+Corrupt/unreadable entries are treated as misses. The directory is
+pruned LRU-by-mtime past ``_MAX_ENTRIES`` so it cannot grow without
+bound. ``python -m tools.raycheck --no-cache`` bypasses it entirely.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+from typing import Optional
+
+CACHE_DIRNAME = ".raycheck_cache"
+_MAX_ENTRIES = 4096
+_PICKLE_PROTO = 4
+
+_engine_fp: Optional[str] = None
+
+
+def engine_fingerprint() -> str:
+    """Hash of the analyzer's own sources (computed once per process)."""
+    global _engine_fp
+    if _engine_fp is None:
+        h = hashlib.sha256()
+        here = os.path.dirname(os.path.abspath(__file__))
+        for name in sorted(os.listdir(here)):
+            if name.endswith(".py"):
+                h.update(name.encode())
+                with open(os.path.join(here, name), "rb") as fh:
+                    h.update(fh.read())
+        _engine_fp = h.hexdigest()
+    return _engine_fp
+
+
+def _key(relpath: str, source_bytes: bytes) -> str:
+    h = hashlib.sha256()
+    h.update(engine_fingerprint().encode())
+    h.update(b"\0")
+    h.update(relpath.replace(os.sep, "/").encode())
+    h.update(b"\0")
+    h.update(source_bytes)
+    return h.hexdigest()[:40]
+
+
+class Cache:
+    def __init__(self, root: str):
+        self.dir = os.path.join(root, CACHE_DIRNAME)
+        self.hits = 0
+        self.misses = 0
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.dir, key + ".pkl")
+
+    def get(self, relpath: str, source_bytes: bytes):
+        p = self._path(_key(relpath, source_bytes))
+        try:
+            with open(p, "rb") as fh:
+                mod = pickle.load(fh)
+            os.utime(p)  # LRU touch
+        except Exception:  # noqa: BLE001 — ANY unreadable/corrupt entry
+            # is a miss (pickle raises ValueError, UnpicklingError,
+            # ImportError, ... depending on how the bytes are mangled);
+            # the cache must never fail a lint run
+            self.misses += 1
+            return None
+        self.hits += 1
+        return mod
+
+    def put(self, relpath: str, source_bytes: bytes, mod) -> None:
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            p = self._path(_key(relpath, source_bytes))
+            tmp = p + f".tmp{os.getpid()}"
+            with open(tmp, "wb") as fh:
+                pickle.dump(mod, fh, protocol=_PICKLE_PROTO)
+            os.replace(tmp, p)  # atomic: concurrent runs never see torn
+        except (OSError, pickle.PicklingError, TypeError):
+            return  # cache is best-effort; analysis never depends on it
+
+    def prune(self) -> None:
+        try:
+            entries = [os.path.join(self.dir, n)
+                       for n in os.listdir(self.dir) if n.endswith(".pkl")]
+        except OSError:
+            return
+        if len(entries) <= _MAX_ENTRIES:
+            return
+
+        def _mtime(p: str) -> float:
+            try:
+                return os.path.getmtime(p)
+            except OSError:
+                return 0.0  # concurrently pruned by another run
+
+        entries.sort(key=_mtime)
+        for p in entries[:len(entries) - _MAX_ENTRIES]:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------
+# run-level cache: the analysis is a pure function of (analyzer
+# sources, rule selection, file contents), so an unchanged tree can
+# skip the whole interprocedural pass — this is what keeps the warm
+# `make lint` / tier-1 TestLiveTree pair fast as the repo grows. Any
+# one-byte change to any input file (or to raycheck itself) misses.
+# ---------------------------------------------------------------------
+
+def run_key(file_digests, rules) -> str:
+    h = hashlib.sha256()
+    h.update(engine_fingerprint().encode())
+    h.update(repr(sorted(rules or [])).encode())
+    for rel, dig in sorted(file_digests):
+        h.update(rel.encode())
+        h.update(b"\0")
+        h.update(dig.encode())
+        h.update(b"\1")
+    return "run-" + h.hexdigest()[:40]
+
+
+def get_run(root: str, key: str):
+    """(analyzed_file_count, findings) for this exact input set, or
+    None. The count is the number of files that actually PARSED on the
+    cold run, so warm and cold runs report identical totals even when
+    the tree contains non-parseable files."""
+    from tools.raycheck.rules import Finding
+    p = os.path.join(root, CACHE_DIRNAME, key + ".pkl")
+    try:
+        with open(p, "rb") as fh:
+            payload = pickle.load(fh)
+        os.utime(p)
+        return payload["files"], [Finding(**row)
+                                  for row in payload["rows"]]
+    except Exception:  # noqa: BLE001 — corrupt entry = miss, never a
+        # failed lint run (see Cache.get)
+        return None
+
+
+def put_run(root: str, key: str, nfiles: int, findings) -> None:
+    rows = [{
+        "rule": f.rule, "path": f.path, "line": f.line,
+        "scope": f.scope, "message": f.message, "detail": f.detail,
+        "chain": tuple(f.chain),
+    } for f in findings]
+    try:
+        d = os.path.join(root, CACHE_DIRNAME)
+        os.makedirs(d, exist_ok=True)
+        p = os.path.join(d, key + ".pkl")
+        tmp = p + f".tmp{os.getpid()}"
+        with open(tmp, "wb") as fh:
+            pickle.dump({"files": nfiles, "rows": rows}, fh,
+                        protocol=_PICKLE_PROTO)
+        os.replace(tmp, p)
+    except (OSError, pickle.PicklingError):
+        pass
+
+
+def digest(source_bytes: bytes) -> str:
+    return hashlib.sha256(source_bytes).hexdigest()

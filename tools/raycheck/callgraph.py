@@ -1,0 +1,419 @@
+"""Whole-program call graph + thread-context classification.
+
+This is the interprocedural spine of raycheck v2. Every function /
+method in the scanned tree becomes a node keyed ``modname:QualName``
+(``ray_tpu._private.gcs.server:GcsServer.Heartbeat``). Edges:
+
+  * **direct** — bare-name calls resolved through the module's own
+    function table and ``from mod import f`` imports; ``alias.f(...)``
+    through ``import mod as alias``.
+  * **method** — ``self.m()`` / ``cls.m()`` resolved in the enclosing
+    class, then its (repo-local) bases, then — matching the old
+    depth-3 resolver so RC001's finding set can only grow — any class
+    in the same module, then a unique match across the whole program.
+  * **rpc** — ``client.call("Name", ...)`` (and acall/call_retrying/
+    call_oneway) edges to the handler registered under ``"Name"``,
+    recovered from the same ``register`` / ``register_instance``
+    extraction RC003 uses.
+  * **thread** — ``threading.Thread(target=f)`` edges to ``f``; the
+    target is a *thread root*.
+
+On top of the graph, :meth:`CallGraph.contexts` classifies the thread
+context every function can execute in:
+
+  * ``io``     — async defs, ``inline=True`` RPC handlers, and
+                 everything sync reachable from them (runs on an
+                 asyncio loop; blocking there stalls the daemon)
+  * ``exec``   — sync RPC handlers without inline (RpcServer runs them
+                 on the executor pool) and their callees
+  * ``thread`` — ``Thread(target=...)`` entry points and callees
+  * ``main``   — nothing above: only ever called synchronously from
+                 user / driver code
+
+A function reachable from several roots carries several tags — that
+multiplicity is exactly what RC007's race detection consumes.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.raycheck.rules import (
+    SourceModule,
+    call_kwarg,
+    const_str,
+    dotted_name,
+    is_true,
+    terminal_attr,
+)
+
+# the one shared RPC-call-method set (rpccontract owns it)
+from tools.raycheck.rpccontract import _CALL_METHODS as _RPC_CALL_METHODS
+
+
+@dataclass
+class FuncInfo:
+    key: str                      # "modname:Qual.Name"
+    mod: SourceModule
+    node: ast.AST                 # FunctionDef | AsyncFunctionDef
+    qualname: str                 # "Class.method" / "func" / nested dotted
+    cls: Optional[str]            # enclosing class name, if a method
+    is_async: bool
+
+    @property
+    def name(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1]
+
+
+@dataclass
+class Edge:
+    caller: str
+    callee: str
+    kind: str                     # direct | method | rpc | thread
+    line: int
+
+
+@dataclass
+class Registration:
+    method: str                   # RPC method name
+    handler_key: Optional[str]    # resolved def, when resolvable
+    inline: bool
+    mod: SourceModule
+    line: int
+    swept: bool = False           # came from a register_instance sweep
+
+
+class CallGraph:
+    def __init__(self, modules: List[SourceModule]):
+        self.modules = modules
+        self.funcs: Dict[str, FuncInfo] = {}
+        # name indexes for resolution
+        self._by_module: Dict[str, Dict[str, str]] = {}   # mod -> qual -> key
+        self._classes: Dict[str, ast.ClassDef] = {}       # "mod:Cls" -> node
+        self._bases: Dict[str, List[str]] = {}            # "mod:Cls" -> names
+        self._methods_global: Dict[str, List[str]] = {}   # name -> [keys]
+        self._funcs_global: Dict[str, List[str]] = {}     # bare fn -> [keys]
+        self.edges: List[Edge] = []
+        self.out: Dict[str, List[Edge]] = {}
+        self.into: Dict[str, List[Edge]] = {}
+        self.registrations: List[Registration] = []
+        self.thread_roots: Set[str] = set()
+        # every method name some call site invokes over RPC (recorded
+        # by _build_edges; contexts() uses it to decide which
+        # register_instance-swept methods are real handler roots)
+        self.rpc_called: Set[str] = set()
+        self._contexts: Optional[Dict[str, Set[str]]] = None
+        self._index()
+        self._collect_registrations()
+        self._build_edges()
+
+    # -- indexing ------------------------------------------------------
+    def _index(self) -> None:
+        for mod in self.modules:
+            table: Dict[str, str] = {}
+            self._by_module[mod.modname] = table
+            for node in mod.all_nodes:
+                # scope_of(def/class) already includes the node's own
+                # name: it IS the dotted qualname
+                if isinstance(node, ast.ClassDef):
+                    qual = mod.scope_of(node)
+                    ckey = f"{mod.modname}:{qual}"
+                    self._classes[ckey] = node
+                    self._bases[ckey] = [
+                        b.id if isinstance(b, ast.Name) else
+                        (b.attr if isinstance(b, ast.Attribute) else "")
+                        for b in node.bases]
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                qual = mod.scope_of(node)
+                key = f"{mod.modname}:{qual}"
+                parts = qual.split(".")
+                cls = parts[-2] if len(parts) >= 2 else None
+                fi = FuncInfo(key=key, mod=mod, node=node, qualname=qual,
+                              cls=cls,
+                              is_async=isinstance(node,
+                                                  ast.AsyncFunctionDef))
+                self.funcs[key] = fi
+                table[qual] = key
+                if cls is not None:
+                    self._methods_global.setdefault(fi.name, []).append(key)
+                else:
+                    self._funcs_global.setdefault(fi.name, []).append(key)
+
+    # -- registration extraction -----------------------------------
+    def _collect_registrations(self) -> None:
+        """ONE source of truth: rpccontract.iter_registrations — the
+        same scan RC003 judges against, so the call graph's handler
+        roots can never drift from the contract checker's."""
+        from tools.raycheck.rpccontract import iter_registrations
+
+        for mod in self.modules:
+            for kind, method, site, payload, inline in \
+                    iter_registrations(mod):
+                if kind == "swept":
+                    # payload = class name, site = the method def
+                    key = f"{mod.modname}:{payload}.{site.name}"
+                    self.registrations.append(Registration(
+                        method=method, handler_key=key, inline=False,
+                        mod=mod, line=site.lineno, swept=True))
+                    continue
+                # explicit register(...) / dynamic dict table entry:
+                # payload is the handler expression (None / Lambda
+                # resolve to no key — lambdas are scanned separately
+                # by RC001)
+                hkey = None
+                if payload is not None and \
+                        not isinstance(payload, ast.Lambda):
+                    hkey = self._resolve_handler_expr(mod, site, payload)
+                self.registrations.append(Registration(
+                    method=method, handler_key=hkey, inline=inline,
+                    mod=mod, line=site.lineno))
+
+    def _resolve_handler_expr(self, mod: SourceModule, site: ast.AST,
+                              handler: ast.expr) -> Optional[str]:
+        name = dotted_name(handler)
+        if name is None:
+            return None
+        scope = mod.scope_of(site)
+        cls = scope.split(".")[0] if "." in scope else None
+        if name.startswith(("self.", "cls.")):
+            return self._resolve_method(mod, cls, name.split(".", 1)[1])
+        return self._resolve_plain(mod, name)
+
+    # -- call resolution ----------------------------------------------
+    def _resolve_method(self, mod: SourceModule, cls: Optional[str],
+                        attr: str) -> Optional[str]:
+        """self.attr() inside class ``cls`` of ``mod``."""
+        table = self._by_module.get(mod.modname, {})
+        # 1. the class itself, then repo-local base classes (by name)
+        seen: Set[str] = set()
+        stack = [cls] if cls else []
+        while stack:
+            c = stack.pop(0)
+            if not c or c in seen:
+                continue
+            seen.add(c)
+            if f"{c}.{attr}" in table:
+                return table[f"{c}.{attr}"]
+            bases = self._bases.get(f"{mod.modname}:{c}")
+            if bases is None:
+                # base defined in another module: find it anywhere
+                cands = [k for k in self._classes if k.endswith(f":{c}")
+                         or k.endswith(f".{c}")]
+                for ck in cands:
+                    bmod, bqual = ck.split(":", 1)
+                    bt = self._by_module.get(bmod, {})
+                    if f"{bqual}.{attr}" in bt:
+                        return bt[f"{bqual}.{attr}"]
+                    stack.extend(self._bases.get(ck, []))
+                continue
+            stack.extend(bases)
+        # 2. any class in the same module (the old depth-3 resolver's
+        #    fallback — kept so RC001's finding set is a strict superset)
+        for qual, key in table.items():
+            if qual.endswith(f".{attr}"):
+                return key
+        # 3. unique match across the program
+        cands2 = self._methods_global.get(attr, [])
+        if len(cands2) == 1:
+            return cands2[0]
+        return None
+
+    def _resolve_plain(self, mod: SourceModule,
+                       dotted: str) -> Optional[str]:
+        """A non-self call: bare name, from-import, or alias.attr."""
+        table = self._by_module.get(mod.modname, {})
+        if dotted in table:
+            return table[dotted]
+        head, _, rest = dotted.partition(".")
+        # from mod import f [as g]
+        target = mod.from_imports.get(head)
+        if target is not None:
+            tmod, _, tname = target.rpartition(".")
+            full = tname if not rest else f"{tname}.{rest}"
+            t = self._by_module.get(tmod, {})
+            if full in t:
+                return t[full]
+            # "from x import y" where y is a module: x.y is the modname
+            t = self._by_module.get(target, {})
+            if rest and rest in t:
+                return t[rest]
+        # import mod [as alias]; alias.f()
+        real = mod.import_aliases.get(head)
+        if real is not None and rest:
+            t = self._by_module.get(real, {})
+            if rest in t:
+                return t[rest]
+        # unique module-level function anywhere (conservative: only when
+        # the name is a single segment and globally unambiguous)
+        if not rest:
+            cands = self._funcs_global.get(dotted, [])
+            if len(cands) == 1:
+                return cands[0]
+        return None
+
+    def resolve_call(self, fi: FuncInfo, call: ast.Call) -> Optional[str]:
+        fn = call.func
+        if isinstance(fn, ast.Attribute) and \
+                isinstance(fn.value, ast.Name) and \
+                fn.value.id in ("self", "cls"):
+            return self._resolve_method(fi.mod, fi.cls, fn.attr)
+        name = dotted_name(fn)
+        if name is None:
+            return None
+        return self._resolve_plain(fi.mod, name)
+
+    # -- edges ---------------------------------------------------------
+    def _build_edges(self) -> None:
+        rpc_handlers: Dict[str, List[str]] = {}
+        for reg in self.registrations:
+            if reg.handler_key:
+                rpc_handlers.setdefault(reg.method, []).append(
+                    reg.handler_key)
+        for fi in self.funcs.values():
+            for stmt in fi.node.body:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    # skip calls that belong to a *nested* def: they run
+                    # when the nested function runs, not here
+                    owner = fi.mod.scope_of(node)
+                    if owner != fi.qualname:
+                        continue
+                    attr = terminal_attr(node.func)
+                    # thread edges: Thread(target=f)
+                    tgt = call_kwarg(node, "target")
+                    if tgt is not None and attr == "Thread":
+                        tkey = self._resolve_target(fi, tgt)
+                        if tkey:
+                            self._add(Edge(fi.key, tkey, "thread",
+                                           node.lineno))
+                            self.thread_roots.add(tkey)
+                        continue
+                    # rpc edges: client.call("Name", ...)
+                    if attr in _RPC_CALL_METHODS and \
+                            isinstance(node.func, ast.Attribute) and \
+                            node.args:
+                        mname = const_str(node.args[0])
+                        if mname:
+                            self.rpc_called.add(mname)
+                            for hkey in rpc_handlers.get(mname, ()):
+                                self._add(Edge(fi.key, hkey, "rpc",
+                                               node.lineno))
+                            continue
+                    callee = self.resolve_call(fi, node)
+                    if callee is not None:
+                        kind = "method" if isinstance(node.func,
+                                                      ast.Attribute) \
+                            else "direct"
+                        self._add(Edge(fi.key, callee, kind, node.lineno))
+
+    def _resolve_target(self, fi: FuncInfo,
+                        tgt: ast.expr) -> Optional[str]:
+        name = dotted_name(tgt)
+        if name is None:
+            return None
+        if name.startswith(("self.", "cls.")):
+            return self._resolve_method(fi.mod, fi.cls,
+                                        name.split(".", 1)[1])
+        return self._resolve_plain(fi.mod, name)
+
+    def _add(self, e: Edge) -> None:
+        self.edges.append(e)
+        self.out.setdefault(e.caller, []).append(e)
+        self.into.setdefault(e.callee, []).append(e)
+
+    # -- reachability --------------------------------------------------
+    def reachable_from(self, roots: Iterable[str],
+                       kinds: Optional[Set[str]] = None,
+                       through_async: bool = False,
+                       ) -> Dict[str, Tuple[str, ...]]:
+        """BFS; returns reached key -> call chain (root..key).  By
+        default traversal stops AT async defs (they run on their own
+        loop turn, not in the caller's frame) — pass
+        ``through_async=True`` to continue through them."""
+        chains: Dict[str, Tuple[str, ...]] = {}
+        queue: List[Tuple[str, Tuple[str, ...]]] = [
+            (r, (r,)) for r in roots if r in self.funcs]
+        while queue:
+            key, chain = queue.pop(0)
+            if key in chains:
+                continue
+            chains[key] = chain
+            fi = self.funcs.get(key)
+            if fi is not None and fi.is_async and not through_async \
+                    and len(chain) > 1:
+                continue
+            for e in self.out.get(key, ()):
+                if kinds is not None and e.kind not in kinds:
+                    continue
+                if e.callee not in chains:
+                    queue.append((e.callee, chain + (e.callee,)))
+        return chains
+
+    # -- thread-context classification ---------------------------------
+    def contexts(self) -> Dict[str, Set[str]]:
+        """func key -> {"io", "exec", "thread", "main"} tags."""
+        if self._contexts is not None:
+            return self._contexts
+        ctx: Dict[str, Set[str]] = {k: set() for k in self.funcs}
+        io_roots: Set[str] = set()
+        exec_roots: Set[str] = set()
+        for key, fi in self.funcs.items():
+            if fi.is_async:
+                io_roots.add(key)
+        # a register_instance sweep exposes EVERY public method, but a
+        # swept method only actually executes as an RPC handler when
+        # some scanned call site names it — public methods of daemon
+        # classes double as ordinary local API (same exemption RC003
+        # makes), and treating them all as executor roots would tag
+        # loop-local helpers "exec". _build_edges already recorded the
+        # RPC-invoked method names.
+        rpc_called = self.rpc_called
+        for reg in self.registrations:
+            if reg.handler_key is None or reg.handler_key not in self.funcs:
+                continue
+            if reg.swept and reg.method not in rpc_called:
+                continue
+            if self.funcs[reg.handler_key].is_async:
+                io_roots.add(reg.handler_key)
+            elif reg.inline:
+                io_roots.add(reg.handler_key)
+            else:
+                exec_roots.add(reg.handler_key)
+        # propagate: sync callees inherit the caller's context; async
+        # defs are pinned "io" (they only ever run on a loop)
+        for tag, roots in (("io", io_roots), ("exec", exec_roots),
+                           ("thread", set(self.thread_roots))):
+            seen: Set[str] = set()
+            queue = [k for k in roots if k in self.funcs]
+            while queue:
+                key = queue.pop(0)
+                if key in seen:
+                    continue
+                seen.add(key)
+                ctx[key].add("io" if self.funcs[key].is_async else tag)
+                for e in self.out.get(key, ()):
+                    if e.kind == "rpc":
+                        continue  # runs on the callee daemon, not here
+                    callee = self.funcs.get(e.callee)
+                    if callee is None or e.callee in seen:
+                        continue
+                    if callee.is_async:
+                        ctx[e.callee].add("io")
+                        continue  # loop schedules it; don't chain tags
+                    if e.kind == "thread":
+                        continue  # thread targets got their own root tag
+                    queue.append(e.callee)
+        for key, tags in ctx.items():
+            if not tags:
+                tags.add("main")
+        self._contexts = ctx
+        return ctx
+
+
+def build(modules: List[SourceModule]) -> CallGraph:
+    return CallGraph(modules)

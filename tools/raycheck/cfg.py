@@ -1,0 +1,345 @@
+"""Per-function control-flow graph with exception and early-return edges.
+
+Statement-granular: every simple statement is a node; ``if``/``while``
+conditions are their own nodes with true/false successors; ``try``
+bodies get exception edges from every may-raise statement to the
+handler-dispatch node (and onward to the enclosing handler / the
+function's exceptional exit); ``return`` / ``raise`` / ``break`` /
+``continue`` route through enclosing ``finally`` blocks.
+
+``finally`` uses the classic merge approximation: the finally body is
+built once and its exits fan out to every target its inbound paths
+need (fall-through, outer exception, function exit). That can create a
+few infeasible paths — fine for a linter (RC006 reports on *some-path*
+facts and carries suppressions), and it keeps the graph linear in the
+source size.
+
+The graph has three distinguished exits:
+
+  * ``exit``       — normal return / falling off the end
+  * ``raise_exit`` — an exception escapes the function
+
+:func:`walk_paths` is the dataflow driver RC006 rides: abstract state
+propagated along edges with per-statement transfer, memoised on
+``(node, state)`` so loops terminate.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Hashable, List, Optional, Set, Tuple
+
+ENTRY = 0
+EXIT = 1
+RAISE_EXIT = 2
+
+
+@dataclass
+class CFG:
+    nodes: Dict[int, Optional[ast.AST]] = field(default_factory=dict)
+    succ: Dict[int, Set[int]] = field(default_factory=dict)
+    # exception successors: the statement may have raised midway, so
+    # dataflow propagates the PRE-state along these edges
+    exc_succ: Dict[int, Set[int]] = field(default_factory=dict)
+    # node -> why control leaves it for EXIT ("return" | "fall")
+    exit_kind: Dict[int, str] = field(default_factory=dict)
+
+    def add_node(self, stmt: Optional[ast.AST]) -> int:
+        nid = len(self.nodes) + 3  # 0/1/2 reserved
+        self.nodes[nid] = stmt
+        self.succ.setdefault(nid, set())
+        return nid
+
+    def add_edge(self, a: int, b: int, exc: bool = False) -> None:
+        (self.exc_succ if exc else self.succ).setdefault(a, set()).add(b)
+
+
+def _may_raise(stmt: ast.AST) -> bool:
+    """Conservative: any statement that performs a call, attribute or
+    subscript access can raise. Pure constants/pass/etc. cannot."""
+    for n in ast.walk(stmt):
+        if isinstance(n, (ast.Call, ast.Attribute, ast.Subscript,
+                          ast.Raise, ast.Assert, ast.BinOp, ast.Await)):
+            return True
+    return False
+
+
+class _Frame:
+    """Builder context: where exceptions / returns / breaks go."""
+
+    def __init__(self):
+        self.exc_target: int = RAISE_EXIT
+        self.finally_chain: List[int] = []  # innermost-first entry nodes
+        # (join node, finally-chain length at loop entry): break/continue
+        # must run exactly the finallys opened INSIDE the loop
+        self.loop_break: List[Tuple[int, int]] = []
+        self.loop_continue: List[Tuple[int, int]] = []
+
+
+class _Builder:
+    def __init__(self):
+        self.cfg = CFG()
+        self.cfg.succ.setdefault(ENTRY, set())
+        self.cfg.succ.setdefault(EXIT, set())
+        self.cfg.succ.setdefault(RAISE_EXIT, set())
+        self.frame = _Frame()
+
+    # route a non-local jump (return/raise/break/continue) through the
+    # enclosing finallys that sit between here and the jump target —
+    # for break/continue only the finallys opened inside the loop
+    # (``count``); return traverses the whole chain
+    def _via_finallys(self, from_node: int, target: int,
+                      count: Optional[int] = None) -> None:
+        chain = self.frame.finally_chain if count is None \
+            else self.frame.finally_chain[:count]
+        if not chain:
+            self.cfg.add_edge(from_node, target)
+            return
+        self.cfg.add_edge(from_node, chain[0])
+        # chain the finallys innermost->outermost, then the real target
+        for a, b in zip(chain, chain[1:]):
+            self._finally_targets.setdefault(a, set()).add(b)
+        self._finally_targets.setdefault(chain[-1], set()).add(target)
+
+    def build(self, fn: ast.AST) -> CFG:
+        self._finally_targets: Dict[int, Set[int]] = {}
+        self._finally_exits: Dict[int, List[int]] = {}
+        exits = self._stmts(fn.body, [ENTRY])
+        for e in exits:
+            self.cfg.exit_kind[e] = "fall"
+            self.cfg.add_edge(e, EXIT)
+        # wire deferred finally fan-outs
+        for fentry, targets in self._finally_targets.items():
+            for fexit in self._finally_exits.get(fentry, [fentry]):
+                for t in targets:
+                    self.cfg.add_edge(fexit, t)
+        return self.cfg
+
+    # returns the set of nodes whose control falls through to whatever
+    # comes next; ``preds`` are the nodes falling into this suite
+    def _stmts(self, body: List[ast.stmt], preds: List[int]) -> List[int]:
+        cur = list(preds)
+        for stmt in body:
+            if not cur:
+                break  # unreachable code after return/raise
+            cur = self._stmt(stmt, cur)
+        return cur
+
+    def _link(self, preds: List[int], node: int) -> None:
+        for p in preds:
+            self.cfg.add_edge(p, node)
+
+    def _stmt(self, stmt: ast.stmt, preds: List[int]) -> List[int]:
+        c = self.cfg
+        if isinstance(stmt, ast.If):
+            cond = c.add_node(stmt)
+            self._link(preds, cond)
+            if _may_raise(stmt.test):
+                c.add_edge(cond, self.frame.exc_target, exc=True)
+            t_exits = self._stmts(stmt.body, [cond])
+            f_exits = self._stmts(stmt.orelse, [cond]) if stmt.orelse \
+                else [cond]
+            return t_exits + f_exits
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            cond = c.add_node(stmt)
+            self._link(preds, cond)
+            if _may_raise(getattr(stmt, "test", None) or
+                          getattr(stmt, "iter", None) or stmt):
+                c.add_edge(cond, self.frame.exc_target, exc=True)
+            after = c.add_node(None)  # virtual loop-exit join
+            depth = len(self.frame.finally_chain)
+            self.frame.loop_break.append((after, depth))
+            self.frame.loop_continue.append((cond, depth))
+            body_exits = self._stmts(stmt.body, [cond])
+            for e in body_exits:
+                c.add_edge(e, cond)
+            self.frame.loop_break.pop()
+            self.frame.loop_continue.pop()
+            # `while True:` (any truthy-constant test) has NO normal
+            # fall-through: the only exits are break/return/raise —
+            # wiring cond->after anyway would fabricate leak paths
+            infinite = isinstance(stmt, ast.While) and \
+                isinstance(stmt.test, ast.Constant) and \
+                bool(stmt.test.value)
+            if not infinite:
+                else_exits = self._stmts(stmt.orelse, [cond]) \
+                    if stmt.orelse else [cond]
+                for e in else_exits:
+                    c.add_edge(e, after)
+            return [after]
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            enter = c.add_node(stmt)
+            self._link(preds, enter)
+            c.add_edge(enter, self.frame.exc_target, exc=True)
+            return self._stmts(stmt.body, [enter])
+        if isinstance(stmt, (ast.Try, getattr(ast, "TryStar", ast.Try))):
+            return self._try(stmt, preds)
+        if isinstance(stmt, ast.Return):
+            node = c.add_node(stmt)
+            self._link(preds, node)
+            if stmt.value is not None and _may_raise(stmt.value):
+                c.add_edge(node, self.frame.exc_target, exc=True)
+            c.exit_kind[node] = "return"
+            self._via_finallys(node, EXIT)
+            return []
+        if isinstance(stmt, ast.Raise):
+            node = c.add_node(stmt)
+            self._link(preds, node)
+            c.add_edge(node, self.frame.exc_target, exc=True)
+            return []
+        if isinstance(stmt, ast.Break):
+            node = c.add_node(stmt)
+            self._link(preds, node)
+            if self.frame.loop_break:
+                target, entry_depth = self.frame.loop_break[-1]
+                self._via_finallys(
+                    node, target,
+                    count=len(self.frame.finally_chain) - entry_depth)
+            return []
+        if isinstance(stmt, ast.Continue):
+            node = c.add_node(stmt)
+            self._link(preds, node)
+            if self.frame.loop_continue:
+                target, entry_depth = self.frame.loop_continue[-1]
+                self._via_finallys(
+                    node, target,
+                    count=len(self.frame.finally_chain) - entry_depth)
+            return []
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            node = c.add_node(None)  # nested defs execute elsewhere
+            self._link(preds, node)
+            return [node]
+        # simple statement
+        node = c.add_node(stmt)
+        self._link(preds, node)
+        if _may_raise(stmt):
+            c.add_edge(node, self.frame.exc_target, exc=True)
+        return [node]
+
+    def _try(self, stmt: ast.Try, preds: List[int]) -> List[int]:
+        c = self.cfg
+        outer_exc = self.frame.exc_target
+        has_finally = bool(stmt.finalbody)
+        after_exits: List[int] = []
+
+        fentry: Optional[int] = None
+        if has_finally:
+            fentry = c.add_node(None)  # finally entry marker
+            self.frame.finally_chain.insert(0, fentry)
+
+        # exception dispatch node for the try body
+        dispatch = c.add_node(None)
+        if stmt.handlers:
+            bare = any(h.type is None or (
+                isinstance(h.type, ast.Name)
+                and h.type.id in ("Exception", "BaseException"))
+                for h in stmt.handlers)
+            if not bare:
+                # a non-matching exception escapes past the handlers
+                if has_finally:
+                    self._finally_targets.setdefault(fentry, set()) \
+                        .add(outer_exc)
+                    c.add_edge(dispatch, fentry)
+                else:
+                    c.add_edge(dispatch, outer_exc)
+        else:
+            if has_finally:
+                self._finally_targets.setdefault(fentry, set()) \
+                    .add(outer_exc)
+                c.add_edge(dispatch, fentry)
+            else:
+                c.add_edge(dispatch, outer_exc)
+
+        self.frame.exc_target = dispatch
+        body_exits = self._stmts(stmt.body, preds)
+        self.frame.exc_target = outer_exc
+
+        # else clause runs after a clean body
+        if stmt.orelse:
+            if has_finally:
+                self.frame.exc_target = fentry
+                self._finally_targets.setdefault(fentry, set()) \
+                    .add(outer_exc)
+            body_exits = self._stmts(stmt.orelse, body_exits)
+            self.frame.exc_target = outer_exc
+
+        # handlers: an exception inside a handler goes outward (through
+        # finally when present)
+        handler_exits: List[int] = []
+        for h in stmt.handlers:
+            if has_finally:
+                self.frame.exc_target = fentry
+                self._finally_targets.setdefault(fentry, set()) \
+                    .add(outer_exc)
+            handler_exits += self._stmts(h.body, [dispatch])
+            self.frame.exc_target = outer_exc
+
+        all_clean = body_exits + handler_exits
+        if has_finally:
+            self.frame.finally_chain.pop(0)
+            fexits = self._stmts(stmt.finalbody, [fentry])
+            self._finally_exits[fentry] = fexits or [fentry]
+            for e in all_clean:
+                c.add_edge(e, fentry)
+            after = c.add_node(None)
+            self._finally_targets.setdefault(fentry, set()).add(after)
+            after_exits = [after]
+        else:
+            after_exits = all_clean
+        return after_exits
+
+
+def build_cfg(fn: ast.AST) -> CFG:
+    """fn: FunctionDef | AsyncFunctionDef."""
+    return _Builder().build(fn)
+
+
+State = Hashable
+Transfer = Callable[[Optional[ast.AST], State], State]
+
+
+def walk_paths(cfg: CFG, transfer: Transfer, init: State,
+               max_states: int = 20000,
+               ) -> List[Tuple[int, str, State]]:
+    """Propagate ``init`` from ENTRY along every edge, applying
+    ``transfer`` at each statement node. Returns the list of
+    ``(node, exit_kind, state)`` for every distinct state that reaches
+    EXIT ("return"/"fall") or RAISE_EXIT ("exc").
+
+    transfer is applied to a node's statement BEFORE leaving the node —
+    except on its exception edge, where the statement may have raised
+    midway: for exception successors the PRE-state is propagated (a
+    ``release()`` that raises never released; conservative and simple).
+    """
+    seen: Set[Tuple[int, State]] = set()
+    results: List[Tuple[int, str, State]] = []
+    stack: List[Tuple[int, State]] = [(ENTRY, init)]
+    budget = max_states
+    while stack and budget > 0:
+        node, state = stack.pop()
+        if (node, state) in seen:
+            continue
+        seen.add((node, state))
+        budget -= 1
+        if node in (EXIT, RAISE_EXIT):
+            continue
+        stmt = cfg.nodes.get(node)
+        post = transfer(stmt, state) if stmt is not None else state
+        for nxt in cfg.succ.get(node, ()):
+            if nxt == EXIT:
+                results.append((node, cfg.exit_kind.get(node, "fall"),
+                                post))
+            elif nxt == RAISE_EXIT:
+                results.append((node, "exc", post))
+            else:
+                stack.append((nxt, post))
+        for nxt in cfg.exc_succ.get(node, ()):
+            if nxt == RAISE_EXIT:
+                results.append((node, "exc", state))
+            elif nxt == EXIT:
+                results.append((node, "exc", state))
+            else:
+                stack.append((nxt, state))
+    return results

@@ -1,0 +1,272 @@
+"""RC006 — resource lifecycle: path-sensitive acquire/release pairing.
+
+Rides the per-function CFG (cfg.py): abstract state = the set of live
+resources, propagated along every edge including exception and
+early-return edges. A path that leaves the function still holding a
+resource is a finding at the acquisition site.
+
+Tracked resources:
+
+  * **bare lock acquisitions** — ``X.acquire()`` (unconditional: no
+    timeout/blocking args) on a lock-shaped receiver must reach
+    ``X.release()`` on every path out, including the exception edges of
+    every intervening call. ``with X:`` blocks are balanced by
+    construction and ignored. Both normal and exceptional exits are
+    findings: a lock leaked on ANY path parks every later waiter — the
+    PR-7 bug family.
+  * **local runtime handles** — a local variable bound to
+    ``RpcClient(...)`` / ``ChunkPipe(...)`` / ``ChunkPipeReader(...)``
+    / ``TensorChannel(...)`` / ``ShmArena(...)`` / ``EventLoopThread(...)``
+    must be closed (``close/destroy/stop/shutdown``) before every
+    *normal* exit, unless it escapes (returned, yielded, stored on an
+    attribute/container, passed to a call) — an escaped handle's
+    lifetime belongs to someone else. Exceptional exits are not
+    reported for handles (GC eventually collects them; locks never
+    un-stick).
+  * **local non-daemon threads** — ``t = threading.Thread(...,
+    daemon=False)`` + ``t.start()`` must reach ``t.join()`` (escape
+    analysis as above). Fire-and-forget daemon threads are RC005's
+    business (explicit ``daemon=`` is enforced there); a *non-daemon*
+    local thread that is never joined outlives the function by design
+    error.
+
+This rule subsumes the "stop() must join" half of RC005 for locals and
+generalizes it from "a join exists somewhere in the body" to "a join
+exists on every path".
+
+The cross-function lease lifecycle (warm ``_LeaseEntry`` handling) is
+covered by the RC008 lease state machine, not here — intraprocedural
+pairing would only see one side of grant/return.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import FrozenSet, List, Optional, Set, Tuple
+
+from tools.raycheck import cfg as cfg_mod
+from tools.raycheck.rules import (
+    Finding,
+    SourceModule,
+    call_kwarg,
+    dotted_name,
+    terminal_attr,
+)
+
+_CLOSEABLE_CTORS = {
+    "RpcClient", "ChunkPipe", "ChunkPipeReader", "TensorChannel",
+    "ShmArena", "EventLoopThread",
+}
+_CLOSE_METHODS = {"close", "destroy", "stop", "shutdown", "join"}
+_LOCKISH = ("lock", "sem", "cond", "mutex")
+# functions whose whole point is to acquire and hold (lock managers,
+# context-manager halves): pairing is cross-function by design
+_EXEMPT_FN = ("__enter__", "__exit__")
+
+
+def _is_lock_recv(name: str) -> bool:
+    low = name.rsplit(".", 1)[-1].lower()
+    return any(t in low for t in _LOCKISH)
+
+
+def _stmt_exprs(stmt: ast.AST) -> List[ast.AST]:
+    """The parts of a CFG node's statement that execute AT that node
+    (compound statements' bodies are separate nodes)."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [i.context_expr for i in stmt.items]
+    return [stmt]
+
+
+def _walk_no_nested_defs(node: ast.AST):
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+# Resource token: (kind, key, line) — kind in {"lock", "handle",
+# "thread"}; key is the dotted receiver / local var name; line is the
+# acquisition site the finding points at.
+Token = Tuple[str, str, int]
+State = FrozenSet[Token]
+
+
+class _FnChecker:
+    def __init__(self, mod: SourceModule, fn: ast.AST,
+                 check_handles: bool):
+        self.mod = mod
+        self.fn = fn
+        self.check_handles = check_handles
+
+    def run(self) -> List[Finding]:
+        if self.fn.name in _EXEMPT_FN or \
+                self.fn.name.startswith(("acquire", "_acquire", "lock_")):
+            return []
+        graph = cfg_mod.build_cfg(self.fn)
+        results = cfg_mod.walk_paths(graph, self._transfer, frozenset())
+        out: List[Finding] = []
+        reported: Set[Tuple[Token, str]] = set()
+        for node, kind, state in results:
+            stmt = graph.nodes.get(node)
+            for tok in state:
+                rkind, key, line = tok
+                if rkind != "lock" and kind == "exc":
+                    continue  # handles/threads: normal-exit leaks only
+                if kind == "exc" and stmt is not None and \
+                        self._releases_here(stmt, key):
+                    continue  # the release itself raising isn't a leak
+                if (tok, kind) in reported:
+                    continue
+                reported.add((tok, kind))
+                out.append(self._finding(tok, kind))
+        return out
+
+    def _releases_here(self, stmt: ast.AST, key: str) -> bool:
+        for n in _walk_no_nested_defs(stmt):
+            if isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Attribute) and \
+                    n.func.attr in ({"release"} | _CLOSE_METHODS):
+                if dotted_name(n.func.value) == key:
+                    return True
+        return False
+
+    def _finding(self, tok: Token, exit_kind: str) -> Finding:
+        rkind, key, line = tok
+        scope = self.mod.scope_of(self.fn)
+        where = "an exception path" if exit_kind == "exc" else \
+            ("an early return" if exit_kind == "return"
+             else "the fall-through exit")
+        if rkind == "lock":
+            msg = (f"{key}.acquire() is not matched by a release() on "
+                   f"{where} — a leaked lock parks every later waiter "
+                   f"forever (use try/finally or a with-block)")
+            detail = f"unreleased:{key}"
+        elif rkind == "thread":
+            msg = (f"non-daemon thread {key!r} is started but not joined "
+                   f"on {where} — it outlives the function and the "
+                   f"process cannot exit cleanly")
+            detail = f"unjoined:{key}"
+        else:
+            msg = (f"{key!r} ({rkind}) is constructed here but {where} "
+                   f"leaves the function without close() — leaked "
+                   f"connections/channels hold sockets, threads and shm")
+            detail = f"unclosed:{key}"
+        return Finding("RC006", self.mod.relpath, line, scope, msg, detail)
+
+    # -- transfer ------------------------------------------------------
+    def _transfer(self, stmt: ast.AST, state: State) -> State:
+        held: Set[Token] = set(state)
+        for expr in _stmt_exprs(stmt):
+            self._apply(expr, stmt, held)
+        return frozenset(held)
+
+    def _apply(self, expr: ast.AST, stmt: ast.AST,
+               held: Set[Token]) -> None:
+        # 1. constructor bindings: v = RpcClient(...)
+        if self.check_handles and isinstance(stmt, (ast.Assign,
+                                                    ast.AnnAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else ([stmt.target] if stmt.value is not None else [])
+            value = stmt.value
+            if isinstance(value, ast.Call) and len(targets) == 1 and \
+                    isinstance(targets[0], ast.Name):
+                ctor = terminal_attr(value.func)
+                if ctor in _CLOSEABLE_CTORS:
+                    var = targets[0].id
+                    # rebinding drops the old token (avoid double
+                    # reports; the common case is a fresh local)
+                    for t in [t for t in held if t[1] == var]:
+                        held.discard(t)
+                    held.add(("handle", var, value.lineno))
+                    # the ctor call's args may still escape OTHER vars
+                    self._scan_uses(value, held, skip_call=value)
+                    return
+                if ctor == "Thread":
+                    dkw = call_kwarg(value, "daemon")
+                    if isinstance(dkw, ast.Constant) and \
+                            dkw.value is False:
+                        var = targets[0].id
+                        held.add(("pre-thread", var, value.lineno))
+                        self._scan_uses(value, held, skip_call=value)
+                        return
+        # 2. calls: acquire/release/close/join/start + escapes
+        for n in _walk_no_nested_defs(expr):
+            if isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Attribute):
+                recv = dotted_name(n.func.value)
+                attr = n.func.attr
+                if recv is not None:
+                    if attr == "acquire" and _is_lock_recv(recv) and \
+                            not n.args and \
+                            call_kwarg(n, "timeout") is None and \
+                            call_kwarg(n, "timeout_s") is None and \
+                            call_kwarg(n, "blocking") is None:
+                        held.add(("lock", recv, n.lineno))
+                        continue
+                    if attr == "release":
+                        for t in [t for t in held
+                                  if t[0] == "lock" and t[1] == recv]:
+                            held.discard(t)
+                        continue
+                    if attr == "start":
+                        for t in [t for t in held if t[0] == "pre-thread"
+                                  and t[1] == recv]:
+                            held.discard(t)
+                            held.add(("thread", recv, t[2]))
+                        continue
+                    if attr in _CLOSE_METHODS:
+                        for t in [t for t in held
+                                  if t[0] in ("handle", "thread",
+                                              "pre-thread")
+                                  and t[1] == recv]:
+                            held.discard(t)
+                        continue
+        # 3. escapes of tracked locals
+        self._scan_uses(expr, held)
+
+    def _scan_uses(self, expr: ast.AST, held: Set[Token],
+                   skip_call: Optional[ast.Call] = None) -> None:
+        """Any use of a tracked local other than ``v.method(...)``
+        receiver position releases ownership (someone else closes it)."""
+        tracked = {t[1]: t for t in held
+                   if t[0] in ("handle", "thread", "pre-thread")}
+        if not tracked:
+            return
+        receiver_ids: Set[int] = set()
+        for n in _walk_no_nested_defs(expr):
+            if isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Attribute) and \
+                    isinstance(n.func.value, ast.Name):
+                receiver_ids.add(id(n.func.value))
+        for n in _walk_no_nested_defs(expr):
+            if skip_call is not None and n is skip_call:
+                continue
+            if isinstance(n, ast.Name) and n.id in tracked and \
+                    id(n) not in receiver_ids and \
+                    isinstance(n.ctx, ast.Load):
+                held.discard(tracked[n.id])
+
+
+def check_rc006(modules: List[SourceModule]) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in modules:
+        # handle/thread tracking is runtime-tree only: tests park
+        # cleanup in fixtures/finalizers the analysis can't see; the
+        # lock pairing check runs everywhere (a leaked lock is a hang
+        # in tests too)
+        check_handles = mod.relpath.startswith("ray_tpu/") or \
+            "/ray_tpu/" in mod.relpath
+        for node in mod.all_nodes:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(
+                    _FnChecker(mod, node, check_handles).run())
+    return findings

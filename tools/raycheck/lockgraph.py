@@ -63,7 +63,7 @@ def _collect_locks(mod: SourceModule) -> Tuple[Dict[str, str], Dict[str, str]]:
             for tgt in node.targets:
                 if isinstance(tgt, ast.Name):
                     module_locks[tgt.id] = f"{mod.modname}.{tgt.id}"
-    for node in ast.walk(mod.tree):
+    for node in mod.all_nodes:
         if isinstance(node, ast.ClassDef):
             for item in node.body:
                 if isinstance(item, ast.Assign) and \
@@ -212,7 +212,7 @@ def check_rc002(modules: List[SourceModule]) -> List[Finding]:
         module_locks, instance_locks = _collect_locks(mod)
         if not module_locks and not instance_locks:
             continue
-        for node in ast.walk(mod.tree):
+        for node in mod.all_nodes:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 w = _HeldWalker(mod, module_locks, instance_locks,
                                 edges, edge_sites, findings)

@@ -1,0 +1,326 @@
+"""RC007 — static lockset race detection over the runtime's hot modules.
+
+Eraser-style, but tuned for signal: for every class in the seeded
+modules we collect each ``self.X`` access (and each module-global
+write) together with
+
+  * the **lockset** held at the access (``with lock:`` nesting plus
+    bare acquire/release spans — the same model RC002 validates
+    dynamically), and
+  * the **thread contexts** the enclosing function can execute in,
+    from the whole-program call graph (``io`` = asyncio loop /
+    inline handlers, ``exec`` = RpcServer executor pool, ``thread`` =
+    ``Thread(target=...)`` fleets, ``main`` = only ever called from
+    driver code).
+
+A *race candidate* is an attribute with a WRITE in one context and a
+read or write in a different context where the locksets of the two
+accesses do not intersect. Raw Eraser floods on CPython code (the GIL
+makes single-word loads/stores atomic, and ``self._closed = True``
+flags are idiomatic), so RC007 only reports the two shapes that have
+actually bitten this codebase:
+
+  * **inconsistent discipline** — the attribute is protected by some
+    lock at one or more sites, but a *cross-context write* touches it
+    with no lock at all. Half-locked state is worse than unlocked: the
+    locked readers think they have exclusion they don't.
+  * **unprotected read-modify-write** — ``self.x += 1`` /
+    ``self.x = self.x ...`` / ``self.x.pop(...)``-style compound
+    mutations in one context while another context accesses the same
+    attribute, no common lock. RMW is not GIL-atomic: two contexts
+    interleave between the read and the write and drop an update.
+
+Accesses inside ``__init__`` / ``__new__`` are construction-time
+(happens-before publication) and never count. Attributes bound to
+known synchronized/immutable types in ``__init__`` (locks, events,
+queues, deques) are skipped — calling their methods is their own
+synchronization.
+
+Scope is seeded exactly where the decentralization work will land
+(ISSUE 15 / ROADMAP item 1): ``_private/core_worker.py``,
+``_private/gcs/``, ``_private/raylet/``, ``_private/memory_store.py``,
+``_private/streaming.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, FrozenSet, List, Optional, Set
+
+from tools.raycheck import callgraph as cg_mod
+from tools.raycheck.lockgraph import _collect_locks, _lock_id
+from tools.raycheck.rules import (
+    Finding,
+    SourceModule,
+    terminal_attr,
+)
+
+_SCOPE_SUFFIXES = (
+    "_private/core_worker.py",
+    "_private/memory_store.py",
+    "_private/streaming.py",
+)
+_SCOPE_DIRS = ("_private/gcs/", "_private/raylet/")
+
+# attribute values assigned in __init__ that are self-synchronizing or
+# effectively immutable — method calls on them need no external lock
+_SYNCED_CTORS = {
+    "Lock", "RLock", "Condition", "Event", "Semaphore",
+    "BoundedSemaphore", "Barrier", "Queue", "SimpleQueue", "LifoQueue",
+    "PriorityQueue", "deque", "Counter", "defaultdict", "OrderedDict",
+    "WeakValueDictionary", "Random",
+}
+# contexts that can actually interleave with each other. "main" (the
+# default for unclassified code) is deliberately NOT active: it covers
+# both genuine driver-thread entry points and one-time startup/restore
+# paths that run before any loop or pool exists — flagging main-vs-io
+# pairs floods with happens-before false positives (e.g. a GCS WAL
+# replay that finishes before the server loop starts). A race is
+# reported only between two *classified* concurrent roots.
+_ACTIVE = ("io", "exec", "thread")
+
+_RMW_METHODS = {
+    "append", "extend", "pop", "popitem", "remove", "discard", "add",
+    "insert", "update", "setdefault", "clear", "popleft", "appendleft",
+}
+
+
+def _in_scope(mod: SourceModule) -> bool:
+    rel = mod.relpath.replace(os.sep, "/")
+    return rel.endswith(_SCOPE_SUFFIXES) or \
+        any(d in rel for d in _SCOPE_DIRS)
+
+
+class Access:
+    __slots__ = ("kind", "line", "func_key", "lockset", "scope", "rmw")
+
+    def __init__(self, kind: str, line: int, func_key: str,
+                 lockset: FrozenSet[str], scope: str, rmw: bool):
+        self.kind = kind          # "read" | "write"
+        self.line = line
+        self.func_key = func_key
+        self.lockset = lockset
+        self.scope = scope
+        self.rmw = rmw
+
+
+class _AccessCollector(ast.NodeVisitor):
+    """One function: every self.X / global access with the held lockset."""
+
+    def __init__(self, mod: SourceModule, func_key: str, scope: str,
+                 module_locks, instance_locks, sink):
+        self.mod = mod
+        self.func_key = func_key
+        self.scope = scope
+        self.module_locks = module_locks
+        self.instance_locks = instance_locks
+        self.sink: Dict[str, List[Access]] = sink
+        self.held: List[str] = []
+
+    def visit_FunctionDef(self, node):  # noqa: N802 — nested defs later
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):  # noqa: N802
+        pass
+
+    def _lock(self, expr: ast.expr) -> Optional[str]:
+        got = _lock_id(self.mod, self.module_locks, self.instance_locks,
+                       expr)
+        return got[0] if got is not None else None
+
+    def visit_With(self, node):  # noqa: N802
+        entered = 0
+        for item in node.items:
+            lid = self._lock(item.context_expr)
+            if lid is not None:
+                self.held.append(lid)
+                entered += 1
+            else:
+                self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(entered):
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_Call(self, node):  # noqa: N802
+        attr = terminal_attr(node.func)
+        if attr in ("acquire", "release") and \
+                isinstance(node.func, ast.Attribute):
+            lid = self._lock(node.func.value)
+            if lid is not None:
+                if attr == "acquire":
+                    self.held.append(lid)
+                elif lid in self.held:
+                    self.held.remove(lid)
+        # container RMW through an attribute: self.xs.append(...)
+        if attr in _RMW_METHODS and isinstance(node.func, ast.Attribute):
+            recv = node.func.value
+            if isinstance(recv, ast.Attribute) and \
+                    isinstance(recv.value, ast.Name) and \
+                    recv.value.id == "self":
+                self._record("write", recv.attr, node.lineno, rmw=True)
+        self.generic_visit(node)
+
+    def _record(self, kind: str, attr: str, line: int,
+                rmw: bool = False) -> None:
+        self.sink.setdefault(attr, []).append(Access(
+            kind, line, self.func_key, frozenset(self.held), self.scope,
+            rmw))
+
+    def visit_Attribute(self, node):  # noqa: N802
+        if isinstance(node.value, ast.Name) and node.value.id == "self":
+            if isinstance(node.ctx, ast.Store):
+                self._record("write", node.attr, node.lineno)
+            elif isinstance(node.ctx, ast.Del):
+                self._record("write", node.attr, node.lineno, rmw=True)
+            else:
+                self._record("read", node.attr, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):  # noqa: N802
+        t = node.target
+        if isinstance(t, ast.Attribute) and \
+                isinstance(t.value, ast.Name) and t.value.id == "self":
+            self._record("write", t.attr, node.lineno, rmw=True)
+            self.visit(node.value)
+            return
+        self.generic_visit(node)
+
+    def visit_Assign(self, node):  # noqa: N802
+        # self.x = <expr reading self.x> is a read-modify-write
+        targets = {t.attr for t in node.targets
+                   if isinstance(t, ast.Attribute)
+                   and isinstance(t.value, ast.Name)
+                   and t.value.id == "self"}
+        if targets:
+            reads = {n.attr for n in ast.walk(node.value)
+                     if isinstance(n, ast.Attribute)
+                     and isinstance(n.value, ast.Name)
+                     and n.value.id == "self"}
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    self._record("write", t.attr, node.lineno,
+                                 rmw=t.attr in reads)
+            self.visit(node.value)
+            return
+        self.generic_visit(node)
+
+
+def _synced_attrs(cls: ast.ClassDef, mod: SourceModule) -> Set[str]:
+    """Attributes whose __init__ value is a self-synchronizing type."""
+    out: Set[str] = set()
+    for item in cls.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                item.name == "__init__":
+            for node in ast.walk(item):
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call):
+                    ctor = terminal_attr(node.value.func)
+                    if ctor in _SYNCED_CTORS or (
+                            ctor and ctor.endswith(
+                                ("Lock", "Event", "Queue", "Condition"))):
+                        for t in node.targets:
+                            if isinstance(t, ast.Attribute) and \
+                                    isinstance(t.value, ast.Name) and \
+                                    t.value.id == "self":
+                                out.add(t.attr)
+    return out
+
+
+def check_rc007(modules: List[SourceModule],
+                graph: Optional[cg_mod.CallGraph] = None) -> List[Finding]:
+    graph = graph or cg_mod.build(modules)
+    contexts = graph.contexts()
+    findings: List[Finding] = []
+    for mod in modules:
+        if not _in_scope(mod):
+            continue
+        module_locks, instance_locks = _collect_locks(mod)
+        for cls in [n for n in mod.tree.body
+                    if isinstance(n, ast.ClassDef)]:
+            accesses: Dict[str, List[Access]] = {}
+            synced = _synced_attrs(cls, mod)
+            for item in cls.body:
+                if not isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if item.name in ("__init__", "__new__", "__del__"):
+                    continue
+                qual = f"{cls.name}.{item.name}"
+                key = f"{mod.modname}:{qual}"
+                col = _AccessCollector(mod, key, qual, module_locks,
+                                       instance_locks, accesses)
+                for stmt in item.body:
+                    col.visit(stmt)
+            findings.extend(_judge(mod, cls, accesses, synced, contexts))
+    return findings
+
+
+def _ctxs(contexts, func_key: str) -> FrozenSet[str]:
+    return frozenset(contexts.get(func_key, {"main"}))
+
+
+def _judge(mod: SourceModule, cls: ast.ClassDef,
+           accesses: Dict[str, List[Access]], synced: Set[str],
+           contexts) -> List[Finding]:
+    out: List[Finding] = []
+    for attr, accs in sorted(accesses.items()):
+        if attr in synced:
+            continue
+        writes = [a for a in accs if a.kind == "write"]
+        if not writes:
+            continue
+        ever_locked = any(a.lockset for a in accs)
+        for w in writes:
+            wctx = _ctxs(contexts, w.func_key)
+            for o in accs:
+                if o is w:
+                    continue
+                # the opposing access must be a write (two RMWs lose
+                # updates) or a LOCKED read (the reader believes it has
+                # exclusion the writer doesn't honor). A bare unlocked
+                # read against a locked write is a GIL-snapshot load —
+                # idiomatic in asyncio+thread CPython and not a lost
+                # update; flagging it buries the real races.
+                if o.kind != "write" and not o.lockset:
+                    continue
+                octx = _ctxs(contexts, o.func_key)
+                # need two DIFFERENT contexts, both actively concurrent
+                pairs = [(cw, co) for cw in wctx for co in octx
+                         if cw != co and cw in _ACTIVE and co in _ACTIVE]
+                if not pairs:
+                    continue
+                if w.lockset & o.lockset:
+                    continue  # common lock: ordered
+                inconsistent = ever_locked and not w.lockset
+                rmw = w.rmw
+                if not (inconsistent or rmw):
+                    continue
+                cw, co = pairs[0]
+                shape = "unprotected read-modify-write" if rmw else \
+                    "inconsistent lock discipline"
+                lockinfo = "no lock held at either site" \
+                    if not (w.lockset or o.lockset) else (
+                        f"other site holds "
+                        f"{sorted(o.lockset or w.lockset)[0]}, "
+                        f"this site holds nothing" if not w.lockset
+                        else f"disjoint locks "
+                        f"{sorted(w.lockset)[0]} vs "
+                        f"{sorted(o.lockset)[0] if o.lockset else 'none'}")
+                out.append(Finding(
+                    "RC007", mod.relpath, w.line, w.scope,
+                    f"{shape}: {cls.name}.{attr} is written here on the "
+                    f"{cw} context and accessed from "
+                    f"{o.scope} (line {o.line}) on the {co} context with "
+                    f"no common lock ({lockinfo}) — interleavings drop "
+                    f"updates or observe torn state",
+                    f"race:{attr}"))
+                break  # one finding per write site is enough
+    return out

@@ -16,10 +16,13 @@ front-door SLO contract derives all waits from the per-request deadline
 happen. Findings carry the ``servepath:`` detail prefix.
 
 Registration sites are resolved by scanning every ``*.register("Name",
-handler, inline=True)`` call; ``self.X`` / bare-name handlers resolve to
-the function def in the same module and are checked transitively (depth
-3) through same-class/same-module helpers, so a blocking call *reachable
-from* an inline handler is still a finding.
+handler, inline=True)`` call; handlers are checked through TRUE
+whole-program call-graph reachability (callgraph.py) — every sync
+function reachable from an inline handler through direct/method edges,
+across module boundaries and at any depth, is scanned. (raycheck v1
+used a same-module depth-3 walk; the v2 finding set is a strict
+superset, and findings now carry the call chain that makes them
+reachable.)
 
 Blocking predicates (the bug classes PR 7 actually hit):
   time.sleep, subprocess.run/call/check_call/check_output,
@@ -39,7 +42,6 @@ from tools.raycheck.rules import (
     SourceModule,
     call_kwarg,
     const_str,
-    dotted_name,
     is_true,
     receiver_name,
     terminal_attr,
@@ -47,7 +49,6 @@ from tools.raycheck.rules import (
 
 _SUBPROCESS_BLOCKING = {"run", "call", "check_call", "check_output"}
 _SOCK_BLOCKING_ATTRS = {"recv", "recv_into", "accept", "connect", "sendall"}
-_MAX_DEPTH = 3
 
 
 def _has_timeout(call: ast.Call) -> bool:
@@ -158,80 +159,61 @@ class _BodyScanner(ast.NodeVisitor):
         self.generic_visit(node)
 
 
-def _function_index(mod: SourceModule) -> Dict[str, ast.AST]:
-    """"func" and "Class.method" -> def node, for transitive resolution."""
-    idx: Dict[str, ast.AST] = {}
-    for node in mod.tree.body:
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            idx[node.name] = node
-        elif isinstance(node, ast.ClassDef):
-            for item in node.body:
-                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    idx[f"{node.name}.{item.name}"] = item
-    return idx
+def _check_inline_reachable(graph, findings: List[Finding]) -> None:
+    """Whole-program reachability from every inline=True handler: any
+    blocking call in a sync function reachable through direct/method
+    edges (across modules, unbounded depth) runs on the server loop."""
+    roots: List[Tuple[str, str]] = []  # (func key, origin text)
+    for reg in graph.registrations:
+        if not reg.inline or reg.handler_key is None:
+            continue
+        fi = graph.funcs.get(reg.handler_key)
+        if fi is None or fi.is_async:
+            continue  # async handlers: the async-def sweep owns them
+        roots.append((reg.handler_key,
+                      f"handler {reg.method!r} is registered inline=True"))
+    seen_sites: Set[Tuple[str, int, str]] = set()
+    scans: Dict[str, _BodyScanner] = {}  # func key -> memoised scan
+    for root, origin in roots:
+        chains = graph.reachable_from([root],
+                                      kinds={"direct", "method"})
+        for key, chain in chains.items():
+            fi = graph.funcs.get(key)
+            if fi is None or fi.is_async:
+                continue  # async helpers: async-def sweep
+            sc = scans.get(key)
+            if sc is None:
+                sc = scans[key] = _BodyScanner(fi.mod).scan(fi.node)
+            via = "" if key == root else \
+                f" (reached via {fi.qualname})"
+            for call, detail, reason in sc.hits:
+                site = (fi.mod.relpath, call.lineno, detail)
+                if site in seen_sites:
+                    continue  # one finding per site, first chain wins
+                seen_sites.add(site)
+                findings.append(Finding(
+                    "RC001", fi.mod.relpath, call.lineno,
+                    fi.mod.scope_of(call),
+                    f"{reason} — runs on the server loop because "
+                    f"{origin}{via}",
+                    f"inline:{detail}",
+                    chain=tuple(c.split(":", 1)[-1] for c in chain)))
 
 
-def _resolve_callee(mod: SourceModule, idx: Dict[str, ast.AST],
-                    scope: str, call: ast.Call) -> Optional[str]:
-    """Resolve a call made inside ``scope`` to a key of ``idx``."""
-    fn = call.func
-    cls = scope.split(".")[0] if "." in scope else None
-    if isinstance(fn, ast.Attribute) and \
-            isinstance(fn.value, ast.Name) and fn.value.id in ("self", "cls"):
-        if cls and f"{cls}.{fn.attr}" in idx:
-            return f"{cls}.{fn.attr}"
-        # self.X where the enclosing class isn't obvious from the scope
-        for key in idx:
-            if key.endswith(f".{fn.attr}"):
-                return key
-        return None
-    if isinstance(fn, ast.Name) and fn.id in idx:
-        return fn.id
-    return None
-
-
-def _inline_handlers(mod: SourceModule) -> List[Tuple[str, ast.expr, int]]:
-    """(method_name, handler_expr, lineno) for inline=True registrations."""
+def _inline_lambdas(mod: SourceModule) -> List[Tuple[str, ast.Lambda]]:
+    """inline=True registrations whose handler is a lambda (no call
+    graph node): scanned directly."""
     out = []
-    for node in ast.walk(mod.tree):
+    for node in mod.all_nodes:
         if isinstance(node, ast.Call) and \
                 terminal_attr(node.func) == "register" and \
                 is_true(call_kwarg(node, "inline")):
             method = const_str(node.args[0]) if node.args else None
             handler = node.args[1] if len(node.args) > 1 else \
                 call_kwarg(node, "handler")
-            if method and handler is not None:
-                out.append((method, handler, node.lineno))
+            if method and isinstance(handler, ast.Lambda):
+                out.append((method, handler))
     return out
-
-
-def _check_reachable(mod: SourceModule, idx: Dict[str, ast.AST],
-                     start_key: str, origin: str,
-                     findings: List[Finding]) -> None:
-    """DFS from a handler def through same-module helpers, flagging
-    blocking calls with the handler named in the message."""
-    seen: Set[str] = set()
-    stack: List[Tuple[str, int]] = [(start_key, 0)]
-    while stack:
-        key, depth = stack.pop()
-        if key in seen or key not in idx:
-            continue
-        seen.add(key)
-        fn = idx[key]
-        if isinstance(fn, ast.AsyncFunctionDef):
-            continue  # async helpers are covered by the async-def sweep
-        sc = _BodyScanner(mod).scan(fn)
-        via = "" if key == start_key else f" (reached via {key})"
-        for call, detail, reason in sc.hits:
-            findings.append(Finding(
-                "RC001", mod.relpath, call.lineno, mod.scope_of(call),
-                f"{reason} — runs on the server loop because {origin}{via}",
-                f"inline:{detail}"))
-        if depth < _MAX_DEPTH:
-            for call in sc.calls_made:
-                callee = _resolve_callee(mod, idx, key, call)
-                if callee is not None:
-                    stack.append((callee, depth + 1))
 
 
 _SERVE_PATH_PREFIXES = ("ray_tpu/serve/", "ray_tpu/llm/")
@@ -288,7 +270,7 @@ def _serve_wait_reason(mod: SourceModule,
 def _check_serve_path(mod: SourceModule, findings: List[Finding]) -> None:
     if not any(mod.relpath.startswith(p) for p in _SERVE_PATH_PREFIXES):
         return
-    for node in ast.walk(mod.tree):
+    for node in mod.all_nodes:
         if isinstance(node, ast.Call):
             hit = _serve_wait_reason(mod, node)
             if hit is not None:
@@ -297,13 +279,17 @@ def _check_serve_path(mod: SourceModule, findings: List[Finding]) -> None:
                     hit[1], hit[0]))
 
 
-def check_rc001(modules: List[SourceModule]) -> List[Finding]:
+def check_rc001(modules: List[SourceModule],
+                graph=None) -> List[Finding]:
+    from tools.raycheck import callgraph as cg_mod
+
+    graph = graph or cg_mod.build(modules)
     findings: List[Finding] = []
     for mod in modules:
         # 0. serve/llm request path: no un-timeouted waits, anywhere
         _check_serve_path(mod, findings)
         # 1. async def bodies anywhere
-        for node in ast.walk(mod.tree):
+        for node in mod.all_nodes:
             if isinstance(node, ast.AsyncFunctionDef):
                 sc = _BodyScanner(mod).scan(node)
                 for call, detail, reason in sc.hits:
@@ -312,30 +298,16 @@ def check_rc001(modules: List[SourceModule]) -> List[Finding]:
                         mod.scope_of(call),
                         f"{reason} — inside async def {node.name}",
                         f"async:{detail}"))
-        # 2. inline=True handlers (+ helpers reachable from them)
-        idx = _function_index(mod)
-        for method, handler, lineno in _inline_handlers(mod):
+        # 2a. inline=True lambda handlers (no call-graph node)
+        for method, handler in _inline_lambdas(mod):
             origin = f"handler {method!r} is registered inline=True"
-            if isinstance(handler, ast.Lambda):
-                sc = _BodyScanner(mod)
-                sc.visit(handler.body)
-                for call, detail, reason in sc.hits:
-                    findings.append(Finding(
-                        "RC001", mod.relpath, call.lineno,
-                        mod.scope_of(call), f"{reason} — {origin}",
-                        f"inline:{detail}"))
-                continue
-            name = dotted_name(handler)
-            if name is None:
-                continue
-            if name.startswith("self.") or name.startswith("cls."):
-                attr = name.split(".", 1)[1]
-                scope = mod.scope_of(handler)
-                cls = scope.split(".")[0] if "." in scope else None
-                key = f"{cls}.{attr}" if cls and f"{cls}.{attr}" in idx \
-                    else next((k for k in idx if k.endswith(f".{attr}")),
-                              attr)
-            else:
-                key = name
-            _check_reachable(mod, idx, key, origin, findings)
+            sc = _BodyScanner(mod)
+            sc.visit(handler.body)
+            for call, detail, reason in sc.hits:
+                findings.append(Finding(
+                    "RC001", mod.relpath, call.lineno,
+                    mod.scope_of(call), f"{reason} — {origin}",
+                    f"inline:{detail}"))
+    # 2b. inline=True handlers: whole-program reachability
+    _check_inline_reachable(graph, findings)
     return findings

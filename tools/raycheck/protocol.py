@@ -1,0 +1,591 @@
+"""RC008 — protocol conformance: checked-in state machines, verified
+statically against every state assignment and comparison in the
+handlers.
+
+The runtime's control protocols are small state machines whose
+constants already live in the code (``_private/drain.py`` and friends)
+but whose *transition rules* lived only in reviewers' heads — which is
+how the PR-8 "final heartbeat resurrects a completed drain" bug
+shipped. This module declares each machine as data and verifies:
+
+  * **known states** — every string compared against or assigned to a
+    machine attribute is a declared state (``"ALVIE"`` is a lint
+    error, not a runtime mystery);
+  * **legal transitions** — when the dominating guards on the path to
+    an assignment pin the pre-state down to a single state, the
+    assignment must be a declared transition (self-transitions are
+    always legal — idempotent re-entry);
+  * **guarded transitions** — transitions the table marks as
+    ``guards`` additionally require a named fact to be established on
+    the path. The node machine's DEAD→ALIVE resurrection requires the
+    heartbeat's ``draining`` flag to have been tested false first: a
+    final heartbeat from a raylet whose drain already completed must
+    NOT re-register the node. Delete that guard and ``make lint``
+    fails.
+
+Machines declared below:
+
+  * **actor**  — GCS actor lifecycle over ``.state``:
+                 PENDING → ALIVE|DEAD, ALIVE → RESTARTING|DEAD,
+                 RESTARTING → ALIVE|DEAD; DEAD is terminal.
+  * **placement_group** — ``.state``: PENDING → CREATED|INFEASIBLE,
+                 everything → REMOVED; REMOVED is terminal.
+  * **node**   — GCS NodeInfo drain machine over the boolean pair
+                 ``(alive, draining)``: ALIVE(T,F), DRAINING(T,T),
+                 DEAD(F,F). ALIVE→DRAINING, DRAINING→DEAD,
+                 ALIVE→DEAD (health-check death), DEAD→ALIVE only
+                 behind the not-draining heartbeat guard.
+  * **raylet_drain** — ``Raylet.draining`` boolean: RUNNING→DRAINING
+                 only; a raylet never un-drains.
+  * **lease**  — core-worker ``_LeaseEntry`` over ``(busy, warm)``:
+                 grants flip busy, completion returns to idle (setting
+                 warm), warmth is never revoked (BUSY_WARM→*_COLD and
+                 IDLE_WARM→IDLE_COLD are illegal: the PR-7/PR-8
+                 free-retry accounting keys off it).
+
+Path facts are collected per function from dominating ``if`` guards
+(both branches), early-terminal guards (``if C: return`` ⇒ ¬C after),
+and ``and``-conjunctions. Boolean machines read truthiness facts
+(``if not node.alive``), string machines read ``==``/``!=``/``in``
+comparisons. Only *singleton* pre-states are enforced — an unknown
+pre-state is never a finding (interprocedural pre-conditions are the
+callers' contract), so the rule stays quiet unless the code itself
+states the pre-state it is violating.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from tools.raycheck.rules import Finding, SourceModule, dotted_name
+
+
+@dataclass
+class Machine:
+    name: str
+    # path fragments this machine is enforced in (substring match on
+    # the repo-relative path)
+    paths: Tuple[str, ...]
+    # receiver name hints (last identifier of the receiver expression)
+    receivers: Tuple[str, ...]
+    # string machine: attr -> None marker via states; bool machine:
+    # attrs maps attribute name -> bit index
+    attr: Optional[str] = None                  # string machine attr
+    states: FrozenSet[str] = frozenset()
+    transitions: FrozenSet[Tuple[str, str]] = frozenset()
+    # boolean-pair machine: (attr, ...) and state name <-> bool tuple
+    bool_attrs: Tuple[str, ...] = ()
+    bool_states: Dict[Tuple[bool, ...], str] = field(default_factory=dict)
+    # (pre, post) -> fact name that must be established (falsy) on the
+    # path for the transition to be legal
+    guards: Dict[Tuple[str, str], str] = field(default_factory=dict)
+    terminal: FrozenSet[str] = frozenset()
+
+
+MACHINES: List[Machine] = [
+    Machine(
+        name="actor",
+        paths=("_private/gcs/",),
+        receivers=("actor", "a", "ex", "existing"),
+        attr="state",
+        states=frozenset({"PENDING", "ALIVE", "RESTARTING", "DEAD"}),
+        transitions=frozenset({
+            ("PENDING", "ALIVE"), ("PENDING", "DEAD"),
+            ("ALIVE", "RESTARTING"), ("ALIVE", "DEAD"),
+            ("RESTARTING", "ALIVE"), ("RESTARTING", "DEAD"),
+        }),
+        terminal=frozenset({"DEAD"}),
+    ),
+    Machine(
+        name="placement_group",
+        paths=("_private/gcs/",),
+        receivers=("pg", "group"),
+        attr="state",
+        states=frozenset({"PENDING", "CREATED", "INFEASIBLE", "REMOVED"}),
+        transitions=frozenset({
+            ("PENDING", "CREATED"), ("PENDING", "INFEASIBLE"),
+            ("PENDING", "REMOVED"), ("CREATED", "REMOVED"),
+            ("INFEASIBLE", "REMOVED"),
+        }),
+        terminal=frozenset({"REMOVED"}),
+    ),
+    Machine(
+        name="node",
+        paths=("_private/gcs/",),
+        receivers=("node", "n"),
+        bool_attrs=("alive", "draining"),
+        bool_states={
+            (True, False): "ALIVE",
+            (True, True): "DRAINING",
+            (False, False): "DEAD",
+            (False, True): "DEAD",  # dead nodes may keep the stale flag
+        },
+        states=frozenset({"ALIVE", "DRAINING", "DEAD"}),
+        transitions=frozenset({
+            ("ALIVE", "DRAINING"),
+            ("DRAINING", "DEAD"),
+            ("ALIVE", "DEAD"),
+            ("DEAD", "ALIVE"),   # resurrection: guarded (below)
+        }),
+        guards={
+            # the PR-8 bug: a final heartbeat from a completed drain
+            # must not resurrect the node — DEAD→ALIVE is only legal
+            # after the heartbeat's draining flag tested false
+            ("DEAD", "ALIVE"): "draining",
+        },
+    ),
+    Machine(
+        name="raylet_drain",
+        paths=("_private/raylet/",),
+        receivers=("self",),
+        bool_attrs=("draining",),
+        bool_states={(False,): "RUNNING", (True,): "DRAINING"},
+        states=frozenset({"RUNNING", "DRAINING"}),
+        transitions=frozenset({("RUNNING", "DRAINING")}),
+        terminal=frozenset({"DRAINING"}),  # a raylet never un-drains
+    ),
+    Machine(
+        name="lease",
+        paths=("_private/core_worker.py",),
+        receivers=("entry", "lease", "e"),
+        bool_attrs=("busy", "warm"),
+        bool_states={
+            (False, False): "IDLE_COLD",
+            (True, False): "BUSY_COLD",
+            (False, True): "IDLE_WARM",
+            (True, True): "BUSY_WARM",
+        },
+        states=frozenset({"IDLE_COLD", "BUSY_COLD", "IDLE_WARM",
+                          "BUSY_WARM"}),
+        transitions=frozenset({
+            ("IDLE_COLD", "BUSY_COLD"), ("IDLE_WARM", "BUSY_WARM"),
+            ("BUSY_COLD", "IDLE_COLD"), ("BUSY_COLD", "IDLE_WARM"),
+            ("BUSY_WARM", "IDLE_WARM"),
+            # warmth is never revoked: *_WARM -> *_COLD is illegal
+        }),
+    ),
+]
+
+
+# ---------------------------------------------------------------------
+# path facts
+# ---------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Fact:
+    """One established condition: ``kind`` in {eq, ne, truthy, falsy};
+    subject is "<recv>.<attr>" for attribute facts or a bare name."""
+    kind: str
+    subject: str
+    value: Optional[str] = None
+
+
+def _subject(expr: ast.expr) -> Optional[str]:
+    return dotted_name(expr)
+
+
+def _facts_from(test: ast.expr, negate: bool) -> List[Fact]:
+    """Facts established when ``test`` evaluated truthy (negate=False)
+    or falsy (negate=True)."""
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _facts_from(test.operand, not negate)
+    if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And) \
+            and not negate:
+        out: List[Fact] = []
+        for v in test.values:
+            out.extend(_facts_from(v, False))
+        return out
+    if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.Or) \
+            and negate:
+        # not (a or b) == (not a) and (not b)
+        out = []
+        for v in test.values:
+            out.extend(_facts_from(v, True))
+        return out
+    if isinstance(test, ast.Compare) and len(test.ops) == 1:
+        subj = _subject(test.left)
+        if subj is None:
+            return []
+        op = test.ops[0]
+        comp = test.comparators[0]
+        if isinstance(op, (ast.Eq, ast.NotEq)):
+            eq = isinstance(op, ast.Eq) ^ negate
+            if isinstance(comp, ast.Constant) and \
+                    isinstance(comp.value, str):
+                return [Fact("eq" if eq else "ne", subj, comp.value)]
+        if isinstance(op, (ast.In, ast.NotIn)) and \
+                isinstance(comp, (ast.Tuple, ast.List, ast.Set)):
+            vals = [e.value for e in comp.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)]
+            if vals and len(vals) == len(comp.elts):
+                inn = isinstance(op, ast.In) ^ negate
+                if inn and len(vals) == 1:
+                    return [Fact("eq", subj, vals[0])]
+                if not inn:
+                    return [Fact("ne", subj, v) for v in vals]
+        return []
+    subj = _subject(test)
+    if subj is not None:
+        return [Fact("falsy" if negate else "truthy", subj)]
+    return []
+
+
+def _terminates(body: Sequence[ast.stmt]) -> bool:
+    """Every path through ``body`` leaves the enclosing suite."""
+    if not body:
+        return False
+    last = body[-1]
+    if isinstance(last, (ast.Return, ast.Raise, ast.Continue, ast.Break)):
+        return True
+    if isinstance(last, ast.If):
+        return bool(last.orelse) and _terminates(last.body) and \
+            _terminates(last.orelse)
+    return False
+
+
+class _SiteCollector:
+    """Walk one function body collecting (assignment-site, facts) and
+    (comparison-site) entries for the machines in scope."""
+
+    def __init__(self, mod: SourceModule):
+        self.mod = mod
+        # assignment groups: consecutive assignments to the same
+        # receiver's machine attrs form ONE compound transition
+        self.assigns: List[Tuple[str, Dict[str, object], int,
+                                 FrozenSet[Fact], str]] = []
+        self.compares: List[Tuple[str, str, str, int, str]] = []
+        self.in_init = False
+
+    def walk_fn(self, fn: ast.AST) -> None:
+        self.in_init = fn.name in ("__init__", "__new__")
+        self._suite(fn.body, frozenset())
+
+    def _suite(self, body: Sequence[ast.stmt],
+               facts: FrozenSet[Fact]) -> None:
+        facts = set(facts)
+        i = 0
+        while i < len(body):
+            stmt = body[i]
+            # group consecutive constant assignments to one receiver
+            if self._machine_assign(stmt) is not None:
+                group: Dict[Tuple[str, str], object] = {}
+                line = stmt.lineno
+                recv0 = None
+                while i < len(body):
+                    got = self._machine_assign(body[i])
+                    if got is None:
+                        break
+                    recv, attr, val = got
+                    if recv0 is None:
+                        recv0 = recv
+                    if recv != recv0:
+                        break
+                    group[(recv, attr)] = val
+                    i += 1
+                self.assigns.append((
+                    recv0, {a: v for (_r, a), v in group.items()}, line,
+                    frozenset(facts), self.scope_line(line)))
+                # the assignment changed the state: facts about the
+                # assigned subjects are stale — and the assignment
+                # itself ESTABLISHES the new value, so a later
+                # assignment in this suite is judged against the state
+                # this one wrote (the review-found DEAD->ALIVE hole)
+                for (_r, attr), val in group.items():
+                    subj = f"{recv0}.{attr}"
+                    self._invalidate(facts, subj)
+                    if isinstance(val, bool):
+                        facts.add(Fact("truthy" if val else "falsy",
+                                       subj))
+                    elif isinstance(val, str):
+                        facts.add(Fact("eq", subj, val))
+                continue
+            self._stmt(stmt, facts)
+            # early-terminal guard: if C: <terminates> ⇒ ¬C afterwards
+            if isinstance(stmt, ast.If) and _terminates(stmt.body) and \
+                    not stmt.orelse:
+                facts.update(_facts_from(stmt.test, True))
+            i += 1
+
+    def scope_line(self, line: int) -> str:
+        # reuse the module's scope map via a node lookup is overkill;
+        # callers attach scope from the enclosing function instead
+        return ""
+
+    def _machine_assign(self, stmt: ast.stmt
+                        ) -> Optional[Tuple[str, str, object]]:
+        """recv_dotted, attr, value for ``X.attr = <const>``."""
+        if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+            return None
+        t = stmt.targets[0]
+        if not isinstance(t, ast.Attribute):
+            return None
+        recv = dotted_name(t.value)
+        if recv is None:
+            return None
+        if isinstance(stmt.value, ast.Constant) and \
+                isinstance(stmt.value.value, (str, bool)):
+            return recv, t.attr, stmt.value.value
+        return None
+
+    @staticmethod
+    def _invalidate(facts: Set[Fact], subject: str) -> None:
+        for f in [f for f in facts if f.subject == subject]:
+            facts.discard(f)
+
+    @classmethod
+    def _invalidate_assigned_within(cls, facts: Set[Fact],
+                                    bodies) -> None:
+        """A compound statement (if/while/try body) MAY have run:
+        every subject it assigns anywhere is unknown afterwards —
+        keeping the pre-branch fact would pin the wrong singleton
+        pre-state for assignments later in the suite."""
+        for body in bodies:
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    tgts = []
+                    if isinstance(node, ast.Assign):
+                        tgts = node.targets
+                    elif isinstance(node, ast.AugAssign):
+                        tgts = [node.target]
+                    for t in tgts:
+                        subj = dotted_name(t) if isinstance(
+                            t, (ast.Attribute, ast.Name)) else None
+                        if subj is not None:
+                            cls._invalidate(facts, subj)
+
+    def _stmt(self, stmt: ast.stmt, facts: Set[Fact]) -> None:
+        # ANY assignment to a tracked-looking subject invalidates the
+        # facts about it (non-constant machine-attr writes included)
+        if isinstance(stmt, (ast.Assign, ast.AugAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            for t in targets:
+                subj = dotted_name(t) if isinstance(
+                    t, (ast.Attribute, ast.Name)) else None
+                if subj is not None:
+                    self._invalidate(facts, subj)
+        # collect comparisons for the typo check
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Compare) and len(node.ops) == 1:
+                subj = _subject(node.left)
+                if subj is None or "." not in subj:
+                    continue
+                recv, attr = subj.rsplit(".", 1)
+                comps = []
+                c = node.comparators[0]
+                if isinstance(c, ast.Constant) and \
+                        isinstance(c.value, str):
+                    comps = [c.value]
+                elif isinstance(c, (ast.Tuple, ast.List, ast.Set)):
+                    comps = [e.value for e in c.elts
+                             if isinstance(e, ast.Constant)
+                             and isinstance(e.value, str)]
+                for v in comps:
+                    self.compares.append((recv, attr, v, node.lineno, ""))
+        if isinstance(stmt, ast.If):
+            then_facts = set(facts) | set(_facts_from(stmt.test, False))
+            self._suite(stmt.body, frozenset(then_facts))
+            else_facts = set(facts) | set(_facts_from(stmt.test, True))
+            self._suite(stmt.orelse, frozenset(else_facts))
+            # a non-terminating branch may have reassigned a subject:
+            # its pre-branch facts must not survive into the rest of
+            # the suite (a terminating branch never reaches it)
+            self._invalidate_assigned_within(facts, [
+                b for b in (stmt.body, stmt.orelse)
+                if b and not _terminates(b)])
+            return
+        if isinstance(stmt, (ast.While,)):
+            then_facts = set(facts) | set(_facts_from(stmt.test, False))
+            self._suite(stmt.body, frozenset(then_facts))
+            self._suite(stmt.orelse, frozenset(facts))
+            self._invalidate_assigned_within(
+                facts, [stmt.body, stmt.orelse])
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._suite(stmt.body, frozenset(facts))
+            self._suite(stmt.orelse, frozenset(facts))
+            self._invalidate_assigned_within(
+                facts, [stmt.body, stmt.orelse])
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            self._suite(stmt.body, frozenset(facts))
+            self._invalidate_assigned_within(facts, [stmt.body])
+            return
+        if isinstance(stmt, ast.Try):
+            self._suite(stmt.body, frozenset(facts))
+            for h in stmt.handlers:
+                self._suite(h.body, frozenset(facts))
+            self._suite(stmt.orelse, frozenset(facts))
+            self._suite(stmt.finalbody, frozenset(facts))
+            self._invalidate_assigned_within(
+                facts, [stmt.body, stmt.orelse, stmt.finalbody]
+                + [h.body for h in stmt.handlers])
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs get their own collector pass
+
+
+# ---------------------------------------------------------------------
+# judging
+# ---------------------------------------------------------------------
+
+def _machine_for(mod: SourceModule, recv: str, attr: str
+                 ) -> Optional[Machine]:
+    rel = mod.relpath
+    leaf = recv.rsplit(".", 1)[-1]
+    for m in MACHINES:
+        if not any(p in rel for p in m.paths):
+            continue
+        if leaf not in m.receivers:
+            continue
+        if m.attr is not None and attr == m.attr:
+            return m
+        if attr in m.bool_attrs:
+            return m
+    return None
+
+
+def _pre_states(m: Machine, recv: str,
+                facts: FrozenSet[Fact]) -> Set[str]:
+    """Possible machine states before the assignment, from path facts
+    about this receiver."""
+    if m.attr is not None:
+        states = set(m.states)
+        subj = f"{recv}.{m.attr}"
+        for f in facts:
+            if f.subject != subj:
+                continue
+            if f.kind == "eq" and f.value in states:
+                states &= {f.value}
+            elif f.kind == "ne":
+                states.discard(f.value)
+        return states
+    # boolean machine: constrain each component
+    allowed: Set[Tuple[bool, ...]] = set(m.bool_states)
+    for i, attr in enumerate(m.bool_attrs):
+        subj = f"{recv}.{attr}"
+        for f in facts:
+            if f.subject != subj:
+                continue
+            if f.kind == "truthy":
+                allowed = {t for t in allowed if t[i]}
+            elif f.kind == "falsy":
+                allowed = {t for t in allowed if not t[i]}
+    return {m.bool_states[t] for t in allowed}
+
+
+def _post_states(m: Machine, pre_tuple_states: Set[str],
+                 assigned: Dict[str, object]) -> Set[Tuple[str, str]]:
+    """(pre, post) pairs implied by the assignment group."""
+    if m.attr is not None:
+        val = assigned.get(m.attr)
+        if not isinstance(val, str):
+            return set()
+        return {(pre, val) for pre in pre_tuple_states}
+    pairs: Set[Tuple[str, str]] = set()
+    for t, pre_name in m.bool_states.items():
+        if pre_name not in pre_tuple_states:
+            continue
+        post = list(t)
+        for i, attr in enumerate(m.bool_attrs):
+            if attr in assigned and isinstance(assigned[attr], bool):
+                post[i] = assigned[attr]
+        post_name = m.bool_states.get(tuple(post))
+        if post_name is not None:
+            pairs.add((pre_name, post_name))
+    return pairs
+
+
+def _guard_satisfied(guard_subject: str, recv: str,
+                     facts: FrozenSet[Fact]) -> bool:
+    """The guarded transition needs the named flag tested FALSY on the
+    path — either as a bare name (RPC parameter) or as an attribute of
+    any receiver."""
+    for f in facts:
+        if f.kind != "falsy":
+            continue
+        leaf = f.subject.rsplit(".", 1)[-1]
+        if leaf == guard_subject:
+            return True
+    return False
+
+
+def check_rc008(modules: List[SourceModule]) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in modules:
+        if not any(any(p in mod.relpath for p in m.paths)
+                   for m in MACHINES):
+            continue
+        for fn in [n for n in mod.all_nodes
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]:
+            col = _SiteCollector(mod)
+            col.walk_fn(fn)
+            scope = mod.scope_of(fn)
+            in_init = fn.name in ("__init__", "__new__")
+            # typo check on comparisons
+            for recv, attr, val, line, _ in col.compares:
+                m = _machine_for(mod, recv, attr)
+                if m is not None and m.attr == attr and \
+                        val not in m.states:
+                    findings.append(Finding(
+                        "RC008", mod.relpath, line, scope,
+                        f"comparison against unknown {m.name} state "
+                        f"{val!r} — declared states: "
+                        f"{', '.join(sorted(m.states))}",
+                        f"unknown-state:{val}"))
+            for recv, assigned, line, facts, _ in col.assigns:
+                groups: Dict[str, Dict[str, object]] = {}
+                for attr, val in assigned.items():
+                    m = _machine_for(mod, recv, attr)
+                    if m is None:
+                        continue
+                    groups.setdefault(m.name, {})[attr] = val
+                for mname, attrs in groups.items():
+                    m = next(x for x in MACHINES if x.name == mname)
+                    if in_init:
+                        continue  # construction: initial state, not a
+                        # transition
+                    if m.attr is not None:
+                        val = attrs.get(m.attr)
+                        if isinstance(val, str) and val not in m.states:
+                            findings.append(Finding(
+                                "RC008", mod.relpath, line, scope,
+                                f"assignment of unknown {m.name} state "
+                                f"{val!r} — declared states: "
+                                f"{', '.join(sorted(m.states))}",
+                                f"unknown-state:{val}"))
+                            continue
+                    pres = _pre_states(m, recv, facts)
+                    pairs = _post_states(m, pres, attrs)
+                    if len(pres) != 1:
+                        continue  # pre-state not pinned: callers' contract
+                    for pre, post in sorted(pairs):
+                        if pre == post:
+                            continue
+                        if (pre, post) not in m.transitions:
+                            findings.append(Finding(
+                                "RC008", mod.relpath, line, scope,
+                                f"illegal {m.name} transition "
+                                f"{pre} -> {post}: not in the declared "
+                                f"protocol table"
+                                + (f" ({pre} is terminal)"
+                                   if pre in m.terminal else ""),
+                                f"illegal:{pre}->{post}"))
+                            continue
+                        guard = m.guards.get((pre, post))
+                        if guard and not _guard_satisfied(guard, recv,
+                                                          facts):
+                            findings.append(Finding(
+                                "RC008", mod.relpath, line, scope,
+                                f"guarded {m.name} transition {pre} -> "
+                                f"{post} without testing {guard!r} "
+                                f"falsy on the path — the PR-8 "
+                                f"resurrection shape: a completed "
+                                f"drain's final heartbeat must not "
+                                f"revive the node",
+                                f"unguarded:{pre}->{post}"))
+    return findings

@@ -53,58 +53,81 @@ def _server_receiver(node: ast.Call) -> bool:
     return "server" in lname or lname in ("srv", "rpc")
 
 
+def iter_registrations(mod: SourceModule):
+    """Every RPC-handler registration in ``mod``, in ONE shared shape —
+    RC003 and the call graph (callgraph.py) both consume this, so the
+    two can never drift on what counts as a handler.
+
+    Yields ``(kind, method, site, payload, inline)``:
+
+      * ``("explicit", name, register_call, handler_expr|None, bool)``
+        — ``server.register("Name", handler, inline=...)``
+      * ``("swept", name, def_node, class_name, False)`` — a public
+        method exposed by ``server.register_instance(self)``
+      * ``("dict", name, dict_node, value_expr, False)`` — a
+        ``{"Name": handler}`` table literal, counted only in modules
+        that actually register dynamically (a server-shaped
+        ``.register()`` whose method arg is not a string literal)
+    """
+    from tools.raycheck.rules import call_kwarg, is_true
+
+    classes = {n.name: n for n in mod.tree.body
+               if isinstance(n, ast.ClassDef)}
+    dynamic_register = False
+    for node in mod.all_nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        attr = terminal_attr(node.func)
+        if attr == "register" and node.args and _server_receiver(node):
+            name = const_str(node.args[0])
+            if name is None:
+                dynamic_register = True
+                continue
+            handler = node.args[1] if len(node.args) > 1 else \
+                call_kwarg(node, "handler")
+            yield ("explicit", name, node, handler,
+                   is_true(call_kwarg(node, "inline")))
+        elif attr == "register_instance" and node.args and \
+                isinstance(node.args[0], ast.Name) and \
+                node.args[0].id == "self":
+            cls_name = mod.scope_of(node).split(".")[0]
+            cls = classes.get(cls_name)
+            if cls is None:
+                continue
+            prefix = ""
+            for kw in node.keywords:
+                if kw.arg == "prefix":
+                    prefix = const_str(kw.value) or ""
+            for item in cls.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) and \
+                        not item.name.startswith("_"):
+                    yield ("swept", prefix + item.name, item, cls_name,
+                           False)
+    if dynamic_register:
+        for node in mod.all_nodes:
+            if isinstance(node, ast.Dict) and node.keys and all(
+                    const_str(k) is not None and isinstance(
+                        v, (ast.Lambda, ast.Name, ast.Attribute))
+                    for k, v in zip(node.keys, node.values)):
+                for k, v in zip(node.keys, node.values):
+                    yield ("dict", const_str(k), node, v, False)
+
+
 def _registered_methods(modules: List[SourceModule]
                         ) -> Tuple[Dict[str, Tuple[str, int]], Set[str]]:
-    """(explicit: name -> (path, line), instance_swept: names)."""
+    """(explicit: name -> (path, line), instance_swept: names) — a thin
+    view over :func:`iter_registrations`, the one registration scan
+    this module shares with the call graph."""
     explicit: Dict[str, Tuple[str, int]] = {}
     swept: Set[str] = set()
     for mod in modules:
-        classes: Dict[str, ast.ClassDef] = {
-            n.name: n for n in mod.tree.body if isinstance(n, ast.ClassDef)}
-        for node in ast.walk(mod.tree):
-            if not isinstance(node, ast.Call):
-                continue
-            attr = terminal_attr(node.func)
-            if attr == "register" and node.args and _server_receiver(node):
-                name = const_str(node.args[0])
-                if name:
-                    explicit.setdefault(name, (mod.relpath, node.lineno))
-            elif attr == "register_instance" and node.args and \
-                    isinstance(node.args[0], ast.Name) and \
-                    node.args[0].id == "self":
-                cls_name = mod.scope_of(node).split(".")[0]
-                cls = classes.get(cls_name)
-                if cls is None:
-                    continue
-                prefix = ""
-                for kw in node.keywords:
-                    if kw.arg == "prefix":
-                        prefix = const_str(kw.value) or ""
-                for item in cls.body:
-                    if isinstance(item, (ast.FunctionDef,
-                                         ast.AsyncFunctionDef)) and \
-                            not item.name.startswith("_"):
-                        swept.add(prefix + item.name)
-        # handler tables: a {"Name": callable, ...} dict literal is a
-        # registration idiom (test helpers loop over it calling
-        # ``register(name, fn)``). Count the keys ONLY when this module
-        # actually registers dynamically (a server-shaped .register()
-        # whose method arg is not a string literal) — without that gate,
-        # any unrelated string-keyed dict would mask typo'd-call
-        # findings tree-wide.
-        dynamic_register = any(
-            isinstance(node, ast.Call)
-            and terminal_attr(node.func) == "register"
-            and _server_receiver(node)
-            and node.args and const_str(node.args[0]) is None
-            for node in ast.walk(mod.tree))
-        if dynamic_register:
-            for node in ast.walk(mod.tree):
-                if isinstance(node, ast.Dict) and node.keys and all(
-                        const_str(k) is not None and isinstance(
-                            v, (ast.Lambda, ast.Name, ast.Attribute))
-                        for k, v in zip(node.keys, node.values)):
-                    swept.update(const_str(k) for k in node.keys)
+        for kind, name, site, _payload, _inline in iter_registrations(mod):
+            if kind == "explicit":
+                explicit.setdefault(name, (mod.relpath, site.lineno))
+            else:  # swept / dict tables: public local API too, exempt
+                # from the unused-handler check
+                swept.add(name)
     return explicit, swept
 
 
@@ -114,7 +137,7 @@ def check_rc003(modules: List[SourceModule]) -> List[Finding]:
     called: Dict[str, Tuple[str, int, str]] = {}
     call_sites: List[Tuple[SourceModule, ast.Call, str]] = []
     for mod in modules:
-        for node in ast.walk(mod.tree):
+        for node in mod.all_nodes:
             if isinstance(node, ast.Call) and \
                     terminal_attr(node.func) in _CALL_METHODS and \
                     isinstance(node.func, ast.Attribute) and node.args:
@@ -138,7 +161,7 @@ def check_rc003(modules: List[SourceModule]) -> List[Finding]:
             scope = "<module>"
             for mod in modules:
                 if mod.relpath == path:
-                    for node in ast.walk(mod.tree):
+                    for node in mod.all_nodes:
                         if isinstance(node, ast.Call) and \
                                 node.lineno == line and \
                                 terminal_attr(node.func) == "register":

@@ -35,14 +35,33 @@ class Finding:
     scope: str  # dotted enclosing scope ("Class.method", "<module>")
     message: str
     detail: str  # short normalized token for the fingerprint
+    # interprocedural findings carry the call chain (root..site) that
+    # makes them reachable — surfaced by --json and in render()
+    chain: Tuple[str, ...] = ()
 
     @property
     def fingerprint(self) -> str:
+        # deliberately line-number-free AND chain-free: drift-stable
         return f"{self.rule}|{self.path}|{self.scope}|{self.detail}"
 
     def render(self) -> str:
-        return (f"{self.path}:{self.line}: {self.rule} [{self.scope}] "
+        base = (f"{self.path}:{self.line}: {self.rule} [{self.scope}] "
                 f"{self.message}")
+        if self.chain:
+            base += f"\n    via: {' -> '.join(self.chain)}"
+        return base
+
+    def as_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "fingerprint": self.fingerprint,
+            "path": self.path,
+            "line": self.line,
+            "scope": self.scope,
+            "message": self.message,
+            "detail": self.detail,
+            "chain": list(self.chain),
+        }
 
 
 class SourceModule:
@@ -75,7 +94,11 @@ class SourceModule:
         self.import_aliases: Dict[str, str] = {}
         # from-imports: local name -> "module.attr" ("sleep" -> "time.sleep")
         self.from_imports: Dict[str, str] = {}
-        for node in ast.walk(self.tree):
+        # one flattened pre-order walk, shared by every rule (the
+        # analysis phases re-walk each tree many times; the list rides
+        # the content-hash cache so warm runs skip even this)
+        self.all_nodes: List[ast.AST] = list(ast.walk(self.tree))
+        for node in self.all_nodes:
             if isinstance(node, ast.Import):
                 for a in node.names:
                     self.import_aliases[a.asname or a.name.split(".")[0]] = a.name
@@ -224,7 +247,7 @@ def check_rc004(modules: List[SourceModule]) -> List[Finding]:
     for mod in modules:
         full, in_tests = _rc004_scope(mod)
         base = os.path.basename(mod.relpath)
-        for node in ast.walk(mod.tree):
+        for node in mod.all_nodes:
             # unseeded process-global randomness
             if full and isinstance(node, ast.Call):
                 fn = node.func
@@ -302,7 +325,7 @@ def _is_thread_ctor(mod: SourceModule, call: ast.Call) -> bool:
 def check_rc005(modules: List[SourceModule]) -> List[Finding]:
     out: List[Finding] = []
     for mod in modules:
-        for node in ast.walk(mod.tree):
+        for node in mod.all_nodes:
             if isinstance(node, ast.Call) and _is_thread_ctor(mod, node):
                 if call_kwarg(node, "daemon") is None:
                     out.append(Finding(
@@ -353,7 +376,8 @@ RuleFn = Callable[[List[SourceModule]], List[Finding]]
 
 RULE_DOCS: Dict[str, str] = {
     "RC001": "loop-blocking: blocking calls inside async def bodies and "
-             "inline=True RPC handlers",
+             "(whole-program call-graph reachable from) inline=True RPC "
+             "handlers",
     "RC002": "lock-order: lock-acquisition cycles and blocking calls made "
              "while holding a module-level lock",
     "RC003": "rpc-contract: RPC call sites with no registered handler; "
@@ -362,12 +386,26 @@ RULE_DOCS: Dict[str, str] = {
              "seeded injectors, silently swallowed exceptions",
     "RC005": "thread-hygiene: Thread without explicit daemon=; stop/close "
              "paths that do not join a stored thread",
+    "RC006": "resource-lifecycle: CFG path-sensitive acquire/release — "
+             "locks, RpcClient/channel/arena handles, started threads "
+             "must be released/closed/joined on every exit path",
+    "RC007": "lockset-race: attributes written in one thread context "
+             "(io/exec/thread) and accessed from another with no common "
+             "lock",
+    "RC008": "protocol-conformance: actor/node-drain/lease/pg state "
+             "assignments verified against checked-in transition tables",
 }
+
+# rules that consume the whole-program call graph (built once per run)
+_GRAPH_RULES = {"RC001", "RC007"}
 
 
 def builtin_rules() -> Dict[str, RuleFn]:
+    from tools.raycheck.lifecycle import check_rc006
     from tools.raycheck.lockgraph import check_rc002
+    from tools.raycheck.lockset import check_rc007
     from tools.raycheck.loopcheck import check_rc001
+    from tools.raycheck.protocol import check_rc008
     from tools.raycheck.rpccontract import check_rc003
 
     return {
@@ -376,13 +414,13 @@ def builtin_rules() -> Dict[str, RuleFn]:
         "RC003": check_rc003,
         "RC004": check_rc004,
         "RC005": check_rc005,
+        "RC006": check_rc006,
+        "RC007": check_rc007,
+        "RC008": check_rc008,
     }
 
 
-def load_modules(paths: List[str], root: Optional[str] = None
-                 ) -> List[SourceModule]:
-    """Parse every .py file under ``paths`` (files or directories)."""
-    root = root or os.getcwd()
+def discover_files(paths: List[str]) -> List[str]:
     files: List[str] = []
     for p in paths:
         if os.path.isfile(p):
@@ -395,15 +433,49 @@ def load_modules(paths: List[str], root: Optional[str] = None
                 for f in sorted(filenames):
                     if f.endswith(".py"):
                         files.append(os.path.join(dirpath, f))
+    return sorted(set(files))
+
+
+def load_modules(paths: List[str], root: Optional[str] = None,
+                 use_cache: bool = False,
+                 contents: Optional[Dict[str, bytes]] = None,
+                 ) -> List[SourceModule]:
+    """Parse every .py file under ``paths`` (files or directories).
+
+    With ``use_cache=True``, per-file :class:`SourceModule` objects are
+    memoised in ``<root>/.raycheck_cache/`` keyed by content digest +
+    analyzer-source fingerprint (see cache.py) — a hit skips the parse
+    and annotation passes and is byte-equivalent to a cold build.
+    ``contents`` optionally supplies pre-read file bytes (path ->
+    bytes); when given it is also the *complete* file list, so the
+    caller's digest sweep and the analysis see exactly the same inputs
+    (no second discovery racing tree mutations).
+    """
+    root = root or os.getcwd()
+    files = list(contents) if contents is not None \
+        else discover_files(paths)
+    cache = None
+    if use_cache:
+        from tools.raycheck.cache import Cache
+        cache = Cache(root)
     mods: List[SourceModule] = []
     for f in sorted(set(files)):
         try:
-            with open(f, "r", encoding="utf-8") as fh:
-                src = fh.read()
+            raw = contents.get(f) if contents is not None else None
+            if raw is None:
+                with open(f, "rb") as fh:
+                    raw = fh.read()
             rel = os.path.relpath(f, root)
-            mods.append(SourceModule(f, rel, src))
+            mod = cache.get(rel, raw) if cache is not None else None
+            if mod is None:
+                mod = SourceModule(f, rel, raw.decode("utf-8"))
+                if cache is not None:
+                    cache.put(rel, raw, mod)
+            mods.append(mod)
         except (SyntaxError, UnicodeDecodeError, OSError):
             continue  # non-parseable files are out of scope, not findings
+    if cache is not None:
+        cache.prune()
     return mods
 
 
@@ -413,9 +485,16 @@ def analyze(modules: List[SourceModule],
     registry = builtin_rules()
     wanted = rules or sorted(registry)
     by_path = {m.relpath: m for m in modules}
+    graph = None
+    if any(r in _GRAPH_RULES for r in wanted):
+        from tools.raycheck import callgraph as cg_mod
+        graph = cg_mod.build(modules)
     findings: List[Finding] = []
     for rid in wanted:
-        for f in registry[rid](modules):
+        fn = registry[rid]
+        got = fn(modules, graph) if rid in _GRAPH_RULES \
+            else fn(modules)
+        for f in got:
             mod = by_path.get(f.path)
             if mod is not None and mod.is_suppressed(f.rule, f.line):
                 continue
