@@ -95,7 +95,7 @@ class _TransientSchedulingError(Exception):
 
 class _LeaseEntry:
     __slots__ = ("lease_id", "worker_addr", "busy", "last_used",
-                 "raylet_addr", "warm")
+                 "raylet_addr", "warm", "drain_final_pushes")
 
     def __init__(
         self,
@@ -117,6 +117,9 @@ class _LeaseEntry:
         # burning the task's max_retries (reference: lease-level retries
         # in normal_task_submitter never charge the app retry budget)
         self.warm = False
+        # recall-override pushes already spent on this (draining) lease
+        # — see CoreWorker._handle_lease_recalled
+        self.drain_final_pushes = 0
 
 
 class _ActorDispatcher:
@@ -2062,7 +2065,8 @@ class CoreWorker(CoreRuntime):
         return get_client(tuple(entry.raylet_addr))
 
     async def _push_tasks(self, specs: List[TaskSpec],
-                          entry: _LeaseEntry) -> None:
+                          entry: _LeaseEntry,
+                          drain_final: bool = False) -> None:
         sc = specs[0].scheduling_class
         live: List[TaskSpec] = []
         for spec in specs:
@@ -2092,6 +2096,11 @@ class CoreWorker(CoreRuntime):
         in_batch: set = set()
         for spec in live:
             p = self._pack_spec(spec)
+            if drain_final:
+                # override: the draining worker must accept this push —
+                # no other node can host the task (see
+                # _handle_lease_recalled)
+                p["drain_final"] = True
             if spec.function_key and (spec.function_key in shipped
                                       or spec.function_key in in_batch):
                 # bytes already live in that worker's key cache — or an
@@ -2184,8 +2193,11 @@ class CoreWorker(CoreRuntime):
             # worker evicted the function from its key cache: one more
             # roundtrip with the bytes attached
             try:
+                retry_payload = self._pack_spec(spec)
+                if drain_final:
+                    retry_payload["drain_final"] = True
                 reply = await client.acall(
-                    "PushTask", spec_payload=self._pack_spec(spec),
+                    "PushTask", spec_payload=retry_payload,
                     timeout=-1)
             except Exception as e:  # noqa: BLE001
                 # EVERY not-yet-pushed retry spec fails/retries with
@@ -2200,6 +2212,19 @@ class CoreWorker(CoreRuntime):
         entry.busy = False
         entry.last_used = time.monotonic()
         entry.warm = True  # survived a full push: see _LeaseEntry.warm
+        if drain_final:
+            # the node is draining: the finished batch was its last work
+            # from this lease — retire it rather than pool it for reuse
+            with self._lock:
+                entries = self._leases.get(sc, [])
+                if entry in entries:
+                    entries.remove(entry)
+            try:
+                await self._lease_raylet(entry).acall(
+                    "ReturnWorkerLease", lease_id=entry.lease_id)
+            except Exception:  # noqa: BLE001 — raylet may already be gone
+                pass
+            return
         await self._on_lease_idle(sc, entry)
 
     def _driver_py_paths(self) -> List[str]:
@@ -2299,13 +2324,55 @@ class CoreWorker(CoreRuntime):
         self._complete_task(spec, reply)
         return {"ok": True}
 
+    # a recalled batch gets this many drain-final pushes back to its
+    # (still alive, draining) worker before we give up and take the
+    # re-lease path anyway — a backstop against a worker that keeps
+    # refusing even the override
+    _DRAIN_FINAL_MAX_PUSHES = 3
+
+    async def _drain_alternative_exists(self, spec: TaskSpec) -> bool:
+        """Can any alive, non-draining node host `spec` at all? Checked
+        against node TOTALS on a forced-fresh view: re-leasing a
+        recalled task is only correct if somewhere else can ever run
+        it."""
+        resources = spec.resources or {}
+        if not resources:
+            return True  # any node hosts a plain task
+        try:
+            nodes = await self._node_view(force=True)
+        except _TransientSchedulingError:
+            return False  # blind: keep the work on the live lease
+        return any(
+            all(n.get("Resources", {}).get(k, 0.0) >= v
+                for k, v in resources.items())
+            for n in nodes)
+
     async def _handle_lease_recalled(self, specs: List[TaskSpec],
                                      entry: _LeaseEntry) -> None:
         """The leased worker's node is draining and refused the push
         (nothing executed): return the lease to its raylet and re-lease
         the tasks elsewhere — a recall is the lease layer's problem, so
-        it never charges the tasks' max_retries."""
+        it never charges the tasks' max_retries.
+
+        Re-leasing is only correct when some other node can actually
+        host the task. A task pinned to the draining node by a custom
+        resource would re-lease into an infeasible request and FAIL —
+        even though the drain deadline exists precisely so in-flight
+        work can finish. These tasks were leased before the drain
+        started, so they ARE in-flight: push them back to the original
+        worker with a `drain_final` override (which the draining worker
+        honors) and retire the lease when the batch completes."""
         sc = specs[0].scheduling_class
+        if not await self._drain_alternative_exists(specs[0]):
+            pushes = entry.drain_final_pushes + 1
+            if pushes <= self._DRAIN_FINAL_MAX_PUSHES:
+                entry.drain_final_pushes = pushes
+                logger.info(
+                    "lease %s recalled (node draining) but no other "
+                    "node fits the resource spec; finishing %d task(s) "
+                    "on the draining node", entry.lease_id[:8], len(specs))
+                await self._push_tasks(specs, entry, drain_final=True)
+                return
         with self._lock:
             entries = self._leases.get(sc, [])
             if entry in entries:

@@ -144,10 +144,39 @@ class _ActorRunner:
 
         return call
 
-    def submit(self, payload: dict) -> None:
+    def submit(self, payload: dict) -> bool:
+        """Accept-or-refuse atomically: a task that passes the dead
+        gate is in ``inflight`` before the gate can flip, so DrainActor
+        either waits for it or the caller re-resolves — never neither."""
         with self.lock:
+            if self.dead:
+                return False
             self.inflight.add(payload["task_id"])
-        self.pool.submit(self._run, payload)
+        try:
+            self.pool.submit(self._run, payload)
+        except RuntimeError:  # pool shut down by a concurrent hard kill
+            with self.lock:
+                self.inflight.discard(payload["task_id"])
+            return False
+        return True
+
+    def submit_batch(self, payloads: List[dict]) -> bool:
+        """Atomic batched accept (see submit): the dead gate is checked
+        once for the whole batch under the lock."""
+        with self.lock:
+            if self.dead:
+                return False
+            for p in payloads:
+                self.inflight.add(p["task_id"])
+        try:
+            for p in payloads:
+                self.pool.submit(self._run, p)
+        except RuntimeError:
+            with self.lock:
+                for p in payloads:
+                    self.inflight.discard(p["task_id"])
+            return False
+        return True
 
     def query(self, task_id_bin: bytes) -> dict:
         with self.lock:
@@ -696,7 +725,10 @@ class WorkerServer:
         return {"ok": True}
 
     def PushTask(self, spec_payload: dict) -> dict:
-        if self._node_draining:
+        if self._node_draining and not spec_payload.get("drain_final"):
+            # drain_final marks work that was leased HERE before the
+            # drain and cannot run anywhere else — the drain deadline
+            # exists so exactly this work can finish; refuse the rest
             return {"node_draining": True}
         self._apply_py_paths(spec_payload.get("py_paths"))
         self._apply_runtime_env(spec_payload.get("runtime_env"))
@@ -754,7 +786,8 @@ class WorkerServer:
         the positional ``replies`` in the final return are the reliable
         fallback for a lost push — the caller claims each (task,
         attempt) exactly once."""
-        if self._node_draining:
+        if self._node_draining and \
+                not all(p.get("drain_final") for p in spec_payloads):
             return {"node_draining": True}
         replies = []
         for p in spec_payloads:
@@ -823,21 +856,21 @@ class WorkerServer:
     def PushActorTask(self, payload: dict) -> dict:
         """Enqueue-and-ack: execution result goes back via ActorTaskDone."""
         runner = self.actors.get(payload["actor_id"])
-        if runner is None or runner.dead:
+        if runner is None or not runner.submit(payload):
             return {"accepted": False}
-        runner.submit(payload)
         return {"accepted": True}
 
     def PushActorTasks(self, payloads: List[dict]) -> dict:
         """Batched enqueue-and-ack (one RPC per caller batch): payloads
-        enqueue in list order, preserving per-caller submission order."""
+        enqueue in list order, preserving per-caller submission order.
+        All-or-nothing: if the batch races the drain gate, nothing is
+        enqueued and the caller re-resolves the whole batch — a partial
+        accept would double-run the accepted prefix elsewhere."""
         if not payloads:
             return {"accepted": True}
         runner = self.actors.get(payloads[0]["actor_id"])
-        if runner is None or runner.dead:
+        if runner is None or not runner.submit_batch(payloads):
             return {"accepted": False}
-        for p in payloads:
-            runner.submit(p)
         return {"accepted": True}
 
     def QueryActorTaskResult(self, actor_id: str, task_id_bin: bytes) -> dict:
@@ -857,7 +890,8 @@ class WorkerServer:
         runner = self.actors.get(actor_id)
         if runner is None:
             return {"ok": True, "absent": True}
-        runner.dead = True  # gates acceptance only; the pool keeps running
+        with runner.lock:  # atomic with submit's accept (see submit)
+            runner.dead = True  # gates acceptance only; the pool keeps running
         deadline = time.monotonic() + max(0.0, timeout_s)
         while time.monotonic() < deadline:
             with runner.lock:
@@ -869,13 +903,21 @@ class WorkerServer:
         return {"ok": True, "drained": leftover == 0, "inflight": leftover}
 
     def KillActor(self, actor_id: str) -> dict:
-        runner = self.actors.pop(actor_id, None)
+        runner = self.actors.get(actor_id)
         if runner is not None:
-            runner.dead = True
+            with runner.lock:
+                runner.dead = True
             runner.pool.shutdown(wait=False, cancel_futures=True)
+            # keep the runner REGISTERED: its results cache must stay
+            # queryable while ActorTaskDone pushes are still in flight.
+            # Popping it here turned a racing lost delivery into an
+            # authoritative-looking "unknown" from a live worker — the
+            # caller then failed a task whose result actually existed
+            # (flaked test_actor_restarts_elsewhere_on_drain). The
+            # process exit below is what frees everything.
             # a dedicated-actor worker exits so its resources free up
-            if not self.actors:
-                threading.Timer(0.2, lambda: os._exit(0)).start()
+            if all(r.dead for r in self.actors.values()):
+                threading.Timer(0.5, lambda: os._exit(0)).start()
         return {"ok": True}
 
     def Exit(self) -> dict:

@@ -43,6 +43,29 @@ class ChannelTimeoutError(TimeoutError):
     pass
 
 
+# segments created by THIS process (tracker-registered on purpose)
+_created_here: set = set()
+
+
+def _attach_shm(name: str) -> shared_memory.SharedMemory:
+    """Attach (not create) a named segment. Attaching registers the
+    segment with THIS process's resource_tracker, which unlinks it when
+    the process exits (cpython#82300) — a killed reader would destroy a
+    segment the writer and other readers still use. Only the creating
+    endpoint may unlink, so deregister the attach (unless this process
+    IS the creator — e.g. a driver opening readers on its own channel —
+    where deregistering would orphan the creator's registration)."""
+    shm = shared_memory.SharedMemory(name=name)
+    if shm._name not in _created_here:
+        try:
+            from multiprocessing import resource_tracker
+
+            resource_tracker.unregister(shm._name, "shared_memory")
+        except Exception:  # noqa: BLE001
+            pass
+    return shm
+
+
 class _Endpoint:
     def __init__(self, name: str, capacity: int, num_readers: int,
                  create: bool):
@@ -53,9 +76,10 @@ class _Endpoint:
         if create:
             self._shm = shared_memory.SharedMemory(
                 name=name, create=True, size=self._hdr + capacity)
+            _created_here.add(self._shm._name)
             self._shm.buf[: self._hdr] = b"\x00" * self._hdr
         else:
-            self._shm = shared_memory.SharedMemory(name=name)
+            self._shm = _attach_shm(name)
         self._owner = create
         # u64 view over the header: ~3x faster than struct.unpack_from
         # per access, and the seqlock protocol reads the header in every
@@ -365,13 +389,14 @@ class _PipeBase:
         if create:
             self._shm = shared_memory.SharedMemory(
                 name=name, create=True, size=size)
+            _created_here.add(self._shm._name)
             # fresh POSIX shm is zero-filled by ftruncate; zero only the
             # slot headers defensively (multi-MiB payload memset wasted)
             for i in range(num_slots):
                 off = i * self._stride
                 self._shm.buf[off: off + _SLOT_HDR] = b"\x00" * _SLOT_HDR
         else:
-            self._shm = shared_memory.SharedMemory(name=name)
+            self._shm = _attach_shm(name)
         self._owner = create
         # one u64 header view per slot (cast views beat struct.unpack
         # in the spin loops), plus one payload view per slot

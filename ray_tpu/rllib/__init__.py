@@ -4,6 +4,11 @@ Algorithms (reference: rllib/algorithms/): PPO, DQN, SAC (discrete),
 IMPALA (V-trace) — all with the same TPU-first shape: CPU env-runner
 actors collect trajectories; the learner is ONE jitted JAX program.
 Built-in gymnasium-compatible env API (numpy CartPole included).
+
+Podracer architectures (ray_tpu.rllib.podracer, arXiv 2104.06272):
+``Anakin`` fuses rollout+update into one jit-sharded program;
+``Sebulba`` streams fixed-shape fragments from an elastic actor fleet
+through shared-memory tensor channels into batched learners.
 """
 
 from ray_tpu.rllib.dqn import DQN, DQNConfig
@@ -22,11 +27,14 @@ from ray_tpu.rllib.offline import (
     JsonWriter,
     collect_offline_data,
 )
+from ray_tpu.rllib.podracer import Anakin, AnakinConfig, Sebulba, SebulbaConfig
 from ray_tpu.rllib.ppo import PPO, PPOConfig, PPOLearner, compute_gae
-from ray_tpu.rllib.rollout import ReplayBuffer, SampleRunner
+from ray_tpu.rllib.rollout import ReplayBuffer, SampleRunner, worker_seed
 from ray_tpu.rllib.sac import SAC, SACConfig
 
 __all__ = [
+    "Anakin",
+    "AnakinConfig",
     "BC",
     "BCConfig",
     "CoordinationGame",
@@ -49,8 +57,11 @@ __all__ = [
     "SAC",
     "SACConfig",
     "SampleRunner",
+    "Sebulba",
+    "SebulbaConfig",
     "compute_gae",
     "make_env",
     "register_env",
     "vtrace_np",
+    "worker_seed",
 ]

@@ -18,7 +18,7 @@ import ray_tpu
 from ray_tpu.rllib.algorithm import AlgorithmConfigBase
 from ray_tpu.rllib.env import make_env
 from ray_tpu.rllib.rollout import (
-    ReplayBuffer, SampleRunner, init_mlp_params, mlp_apply,
+    ReplayBuffer, SampleRunner, init_mlp_params, mlp_apply, worker_seed,
 )
 
 
@@ -136,9 +136,12 @@ class DQN:
         self.obs_dim = probe.observation_dim
         self.num_actions = probe.num_actions
         self.learner = DQNLearner(cfg, self.obs_dim, self.num_actions)
-        self.buffer = ReplayBuffer(cfg.buffer_capacity, self.obs_dim, cfg.seed)
+        # the buffer draws from the same fan-out, one index past the runners
+        self.buffer = ReplayBuffer(
+            cfg.buffer_capacity, self.obs_dim,
+            worker_seed(cfg.seed, cfg.num_env_runners))
         self.runners = [
-            SampleRunner.remote(cfg.env, cfg.hidden, cfg.seed + i,
+            SampleRunner.remote(cfg.env, cfg.hidden, worker_seed(cfg.seed, i),
                                 mode="epsilon", net_key="q")
             for i in range(cfg.num_env_runners)
         ]

@@ -45,7 +45,9 @@ class CartPole(Env):
         self.x_threshold = 2.4
         self.theta_threshold = 12 * 2 * np.pi / 360
         self.max_steps = 500
-        self._rng = np.random.RandomState()
+        # deterministic default: an unseeded RandomState made runs that
+        # never pass an explicit seed to reset() unreproducible
+        self._rng = np.random.RandomState(0)
         self.state = None
         self.t = 0
 

@@ -24,7 +24,7 @@ import ray_tpu
 from ray_tpu.rllib.algorithm import AlgorithmConfigBase
 from ray_tpu.rllib.env import make_env
 from ray_tpu.rllib.ppo import init_policy, policy_logits, value_fn
-from ray_tpu.rllib.rollout import SampleRunner
+from ray_tpu.rllib.rollout import SampleRunner, worker_seed
 
 
 def vtrace_np(values, next_values, rewards, discounts, rhos, cs,
@@ -195,7 +195,7 @@ class IMPALA:
         self.num_actions = probe.num_actions
         self.learner = IMPALALearner(cfg, self.obs_dim, self.num_actions)
         self.runners = [
-            SampleRunner.remote(cfg.env, cfg.hidden, cfg.seed + i,
+            SampleRunner.remote(cfg.env, cfg.hidden, worker_seed(cfg.seed, i),
                                 mode="categorical", net_key="pi")
             for i in range(cfg.num_env_runners)
         ]

@@ -1,0 +1,43 @@
+"""Podracer RL architectures (reference: arXiv 2104.06272).
+
+- ``Anakin`` — colocated: env stepping + V-trace update fused into one
+  jit-sharded program (podracer/anakin.py).
+- ``Sebulba`` — split fleets: SampleRunner-derived pod actors stream
+  fixed-shape fragments through double-buffered TensorChannel slots
+  into batched learners (podracer/sebulba.py), with elastic membership
+  under node drains (podracer/fleet.py).
+"""
+
+from ray_tpu.rllib.podracer.anakin import (
+    Anakin,
+    AnakinConfig,
+    fragment_loss,
+)
+from ray_tpu.rllib.podracer.codec import (
+    FragmentSpec,
+    flat_param_size,
+    pack_params,
+    unpack_params,
+)
+from ray_tpu.rllib.podracer.fleet import FleetManager
+from ray_tpu.rllib.podracer.sebulba import (
+    PodActor,
+    PodLearner,
+    Sebulba,
+    SebulbaConfig,
+)
+
+__all__ = [
+    "Anakin",
+    "AnakinConfig",
+    "FleetManager",
+    "FragmentSpec",
+    "PodActor",
+    "PodLearner",
+    "Sebulba",
+    "SebulbaConfig",
+    "flat_param_size",
+    "fragment_loss",
+    "pack_params",
+    "unpack_params",
+]

@@ -1,0 +1,78 @@
+"""Pure-JAX CartPole for the Anakin architecture.
+
+Anakin (PAPERS.md, arXiv 2104.06272) colocates env stepping with the
+learner inside ONE jitted program, which requires the environment
+itself to be jax-traceable. This module mirrors the numpy dynamics of
+``ray_tpu.rllib.env.CartPole`` exactly (same constants, termination
+thresholds, and 500-step truncation) so the loss computed on an Anakin
+rollout is directly comparable to the host-side IMPALA path — the
+parity test in tests/test_podracer.py holds the two to the same
+numbers.
+
+State layout: (obs[4] float32, t int32). Reset and auto-reset use the
+caller-provided key; nothing here draws ambient randomness.
+"""
+
+from __future__ import annotations
+
+GRAVITY = 9.8
+MASSCART = 1.0
+MASSPOLE = 0.1
+LENGTH = 0.5
+FORCE_MAG = 10.0
+TAU = 0.02
+X_THRESHOLD = 2.4
+THETA_THRESHOLD = 12 * 2 * 3.141592653589793 / 360
+MAX_STEPS = 500
+
+
+def reset(key):
+    """Fresh (obs, t) state from a PRNG key."""
+    import jax
+    import jax.numpy as jnp
+
+    obs = jax.random.uniform(
+        key, (4,), jnp.float32, minval=-0.05, maxval=0.05)
+    return obs, jnp.int32(0)
+
+
+def step(state, action):
+    """One dynamics step. Returns (next_state, reward, terminated,
+    truncated) — identical math to env.CartPole.step."""
+    import jax.numpy as jnp
+
+    obs, t = state
+    x, x_dot, theta, theta_dot = obs[0], obs[1], obs[2], obs[3]
+    force = jnp.where(action == 1, FORCE_MAG, -FORCE_MAG)
+    costheta, sintheta = jnp.cos(theta), jnp.sin(theta)
+    total_mass = MASSCART + MASSPOLE
+    polemass_length = MASSPOLE * LENGTH
+    temp = (force + polemass_length * theta_dot**2 * sintheta) / total_mass
+    thetaacc = (GRAVITY * sintheta - costheta * temp) / (
+        LENGTH * (4.0 / 3.0 - MASSPOLE * costheta**2 / total_mass))
+    xacc = temp - polemass_length * thetaacc * costheta / total_mass
+    x = x + TAU * x_dot
+    x_dot = x_dot + TAU * xacc
+    theta = theta + TAU * theta_dot
+    theta_dot = theta_dot + TAU * thetaacc
+    nobs = jnp.stack([x, x_dot, theta, theta_dot]).astype(jnp.float32)
+    t = t + 1
+    terminated = (jnp.abs(x) > X_THRESHOLD) | \
+        (jnp.abs(theta) > THETA_THRESHOLD)
+    truncated = t >= MAX_STEPS
+    return (nobs, t), jnp.float32(1.0), terminated, truncated
+
+
+def step_autoreset(state, action, reset_key):
+    """Step, then reset in-place when the episode ended (the Anakin
+    rollout never leaves the jitted program to reset). Returns
+    (next_state, obs_before, reward, terminated, truncated) where
+    next_state is the reset state on done."""
+    import jax
+    import jax.numpy as jnp
+
+    (nobs, t), reward, terminated, truncated = step(state, action)
+    done = terminated | truncated
+    robs, rt = reset(reset_key)
+    nxt = (jnp.where(done, robs, nobs), jnp.where(done, rt, t))
+    return nxt, reward, terminated, truncated

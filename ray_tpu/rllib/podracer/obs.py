@@ -1,0 +1,46 @@
+"""Per-stage observability for the Podracer pipelines.
+
+Every hop of the trajectory path gets a named span (recorded through
+``observability.tracing`` when tracing is enabled/sampled) plus an
+always-on wall-clock accumulator, so both the trace view and the bench
+rows can attribute time to env stepping vs transport vs learning.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict
+
+from ray_tpu.observability import tracing
+
+STAGE_ENV_STEP = "podracer.env_step"
+STAGE_ENQUEUE = "podracer.enqueue"
+STAGE_DEQUEUE = "podracer.dequeue"
+STAGE_UPDATE = "podracer.update"
+STAGE_WEIGHT_SYNC = "podracer.weight_sync"
+
+
+class StageTimes:
+    """Cheap per-stage wall-clock accounting; `track` also emits a
+    tracing span so enabled traces show the same stage names."""
+
+    def __init__(self) -> None:
+        self.seconds: Dict[str, float] = {}
+        self.counts: Dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def track(self, stage: str, **attrs):
+        t0 = time.perf_counter()
+        with tracing.span(stage, kind="podracer", attrs=attrs or None):
+            yield
+        dt = time.perf_counter() - t0
+        self.seconds[stage] = self.seconds.get(stage, 0.0) + dt
+        self.counts[stage] = self.counts.get(stage, 0) + 1
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        return {
+            stage: {"s": round(self.seconds[stage], 6),
+                    "n": self.counts.get(stage, 0)}
+            for stage in self.seconds
+        }

@@ -21,6 +21,7 @@ import numpy as np
 import ray_tpu
 from ray_tpu.rllib.algorithm import AlgorithmConfigBase
 from ray_tpu.rllib.env import Env, make_env
+from ray_tpu.rllib.rollout import worker_seed
 
 
 # ---------------------------------------------------------------------------
@@ -262,7 +263,7 @@ class PPO:
         self.num_actions = probe.num_actions
         self.learner = PPOLearner(cfg, self.obs_dim, self.num_actions)
         self.runners = [
-            EnvRunner.remote(cfg.env, cfg.hidden, cfg.seed + i)
+            EnvRunner.remote(cfg.env, cfg.hidden, worker_seed(cfg.seed, i))
             for i in range(cfg.num_env_runners)
         ]
         self.iteration = 0

@@ -18,6 +18,17 @@ import ray_tpu
 from ray_tpu.rllib.env import Env, make_env
 
 
+def worker_seed(base_seed: int, worker_index: int) -> int:
+    """THE seed fan-out: every per-worker RNG in rllib (env runners,
+    pod actors, replay buffers, learner ranks) derives its seed from
+    the config seed and its worker index through this one function.
+    A multiplicative split keeps streams distinct across BOTH axes —
+    the naive ``seed + i`` collides (seed=0, i=1) with (seed=1, i=0),
+    so two configs differing only in seed could share runner streams."""
+    return (int(base_seed) * 1_000_003 + 15_485_863 * (int(worker_index) + 1)) \
+        % (2 ** 31 - 1)
+
+
 def mlp_forward(layers: Dict, x: np.ndarray, n_hidden: int) -> np.ndarray:
     for i in range(n_hidden):
         x = np.tanh(x @ layers[f"w{i}"] + layers[f"b{i}"])

@@ -20,7 +20,8 @@ import ray_tpu
 from ray_tpu.rllib.algorithm import AlgorithmConfigBase
 from ray_tpu.rllib.env import make_env
 from ray_tpu.rllib.rollout import (
-    ReplayBuffer, SampleRunner, init_mlp_params, mlp_apply as _mlp,
+    ReplayBuffer, SampleRunner, init_mlp_params, worker_seed,
+    mlp_apply as _mlp,
 )
 
 
@@ -170,9 +171,12 @@ class SAC:
         self.obs_dim = probe.observation_dim
         self.num_actions = probe.num_actions
         self.learner = SACLearner(cfg, self.obs_dim, self.num_actions)
-        self.buffer = ReplayBuffer(cfg.buffer_capacity, self.obs_dim, cfg.seed)
+        # the buffer draws from the same fan-out, one index past the runners
+        self.buffer = ReplayBuffer(
+            cfg.buffer_capacity, self.obs_dim,
+            worker_seed(cfg.seed, cfg.num_env_runners))
         self.runners = [
-            SampleRunner.remote(cfg.env, cfg.hidden, cfg.seed + i,
+            SampleRunner.remote(cfg.env, cfg.hidden, worker_seed(cfg.seed, i),
                                 mode="categorical", net_key="pi")
             for i in range(cfg.num_env_runners)
         ]

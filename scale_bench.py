@@ -586,6 +586,150 @@ def bench_combined(n_tasks: int, n_actors: int) -> dict:
     }
 
 
+def _rl_measure(algo, min_frames: int) -> dict:
+    """Timed steps/s window over `algo.train()` calls. The first call is
+    the warm-up (jit compile + initial weight publish) and is excluded.
+    Handles both counters: Sebulba reports cumulative
+    num_env_steps_trained, IMPALA reports per-call
+    num_env_steps_sampled."""
+    r = algo.train()
+    cumulative = "num_env_steps_trained" in r
+    base = r.get("num_env_steps_trained", 0)
+    t0 = time.perf_counter()
+    frames = 0
+    while frames < min_frames:
+        r = algo.train()
+        if cumulative:
+            frames = r["num_env_steps_trained"] - base
+        else:
+            frames += r["num_env_steps_sampled"]
+    wall = time.perf_counter() - t0
+    return {
+        "frames": int(frames),
+        "wall_s": round(wall, 3),
+        "steps_per_s": round(frames / max(1e-9, wall), 1),
+        "episode_return_mean": round(
+            float(r.get("episode_return_mean", 0.0)), 2),
+    }
+
+
+def _bench_rl_preempt(n_frames: int) -> dict:
+    """Sebulba elasticity leg: 2 pod actors pinned to their own nodes,
+    one node preempted (seeded drain) mid-stream. Records steps/s
+    before and after, and the zero-app-error claim the podracer soak
+    test enforces."""
+    import threading
+
+    import ray_tpu
+    from ray_tpu._private.chaos import PreemptionInjector
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.rllib import SebulbaConfig
+
+    cluster = Cluster()
+    cluster.add_node(num_cpus=4)  # head: driver + learner
+    cluster.add_node(num_cpus=1, resources={"pod": 1})
+    cluster.add_node(num_cpus=1, resources={"pod": 1})
+    cluster.wait_for_nodes()
+    algo = None
+    try:
+        ray_tpu.init(address=cluster.address)
+        cfg = SebulbaConfig(num_actors=2, rollout_fragment_length=32,
+                            updates_per_train=4, seed=0,
+                            actor_resources={"pod": 1})
+        algo = cfg.build()
+        r = algo.train()  # warm
+        f0 = r["num_env_steps_trained"]
+        t0 = time.perf_counter()
+        while r["num_env_steps_trained"] - f0 < n_frames:
+            r = algo.train()
+        pre_rate = (r["num_env_steps_trained"] - f0) \
+            / (time.perf_counter() - t0)
+
+        injector = PreemptionInjector(cluster, seed=7, deadline_s=2.0,
+                                      jitter_s=0.0)
+        done = threading.Event()
+
+        def _preempt():
+            injector.preempt_one()
+            done.set()
+
+        t = threading.Thread(target=_preempt, daemon=True)
+        t.start()
+        # keep training THROUGH the drain — elasticity is the claim
+        while not done.is_set():
+            r = algo.train()
+        t.join(timeout=30)
+        deadline = time.monotonic() + 60
+        while len(r["live_actors"]) != 1 \
+                and time.monotonic() < deadline:
+            r = algo.train()
+        # recovered window: the surviving actor feeds the learner alone
+        f1 = r["num_env_steps_trained"]
+        t1 = time.perf_counter()
+        while r["num_env_steps_trained"] - f1 < n_frames:
+            r = algo.train()
+        post_rate = (r["num_env_steps_trained"] - f1) \
+            / (time.perf_counter() - t1)
+        return {
+            "pre_steps_per_s": round(pre_rate, 1),
+            "post_steps_per_s": round(post_rate, 1),
+            "live_actors_after": len(r["live_actors"]),
+            "app_errors": r["app_errors"],
+            "order_errors": r["order_errors"],
+        }
+    finally:
+        if algo is not None:
+            try:
+                algo.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def bench_rl(n_frames: int, fleet_sizes=(1, 2, 4),
+             preempt: bool = True) -> dict:
+    """Podracer RL row: single-learner IMPALA baseline vs Sebulba at
+    fleet sizes, same fragment shape (64 steps) and updates-per-call,
+    plus the mid-run preemption leg on a 3-node cluster. The headline
+    ratio is `sebulba_vs_impala` — multi-actor streaming through the
+    TensorChannel slots vs the object-path baseline."""
+    import ray_tpu
+    from ray_tpu.rllib import IMPALAConfig, SebulbaConfig
+
+    out: dict = {"frames_per_point": n_frames}
+    ray_tpu.init(num_cpus=8)
+    try:
+        cfg = IMPALAConfig(num_env_runners=1, rollout_fragment_length=64,
+                           fragments_per_iteration=8, seed=0)
+        algo = cfg.build()
+        out["impala_1_runner"] = _rl_measure(algo, n_frames)
+        algo.stop()
+        for k in fleet_sizes:
+            # learner-bound workload: the fleet grows actors first, and
+            # a second learner comes in at 4 actors (the Sebulba scaling
+            # axis — rank 0 broadcasts params every 2nd train call)
+            cfg = SebulbaConfig(num_actors=k,
+                                num_learners=2 if k >= 4 else 1,
+                                rollout_fragment_length=64,
+                                updates_per_train=64, pump_fragments=8,
+                                weight_sync_interval=16,
+                                sync_every_iterations=2, seed=0)
+            algo = cfg.build()
+            out[f"sebulba_{k}_actors"] = _rl_measure(algo, n_frames)
+            algo.stop()
+    finally:
+        ray_tpu.shutdown()
+    if fleet_sizes:
+        best = max(out[f"sebulba_{k}_actors"]["steps_per_s"]
+                   for k in fleet_sizes)
+        out["sebulba_vs_impala"] = round(
+            best / max(1e-9, out["impala_1_runner"]["steps_per_s"]), 2)
+    if preempt:
+        out["preempt_1_actor"] = _bench_rl_preempt(max(256, n_frames // 2))
+    return out
+
+
 def _run_phase(phase: str, n: int, n2: int = 0) -> None:
     """Child-process body: one phase against a fresh runtime."""
     import faulthandler
@@ -603,6 +747,12 @@ def _run_phase(phase: str, n: int, n2: int = 0) -> None:
     if phase == "preempt_1of2_nodes":
         # builds (and tears down) its own 2-node cluster
         out = bench_preempt_1of2_nodes(n)
+        print("PHASE_JSON " + json.dumps(out), flush=True)
+        return
+    if phase == "rl":
+        # manages its own runtimes (local for the throughput points,
+        # a 3-node cluster for the preemption leg); n = frames/point
+        out = bench_rl(n)
         print("PHASE_JSON " + json.dumps(out), flush=True)
         return
     if phase == "serve_soak":
@@ -662,6 +812,7 @@ def main() -> None:
     n_preempt = max(400, int(2_000 * args.scale))
     n_col_ops = max(10, int(30 * args.scale))
     n_soak_clients = max(24, int(200 * args.scale))
+    n_rl_frames = max(2048, int(16_384 * args.scale))
 
     # one DRIVER PROCESS per phase, like the reference's release suite
     # (release_tests.yaml runs many_tasks / many_actors / many_pgs as
@@ -673,7 +824,8 @@ def main() -> None:
                   ("combined", n_tasks, n_actors),
                   ("preempt_1of2_nodes", n_preempt, 0),
                   ("collective", n_col_ops, 0),
-                  ("serve_soak", n_soak_clients, 0))
+                  ("serve_soak", n_soak_clients, 0),
+                  ("rl", n_rl_frames, 0))
     if args.only:
         all_phases = tuple(p for p in all_phases if p[0] == args.only)
         try:

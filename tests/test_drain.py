@@ -298,3 +298,60 @@ class TestWarmLeaseDeadWorker:
             assert pid2 != pid1
         finally:
             ray_tpu.shutdown()
+
+
+class TestDrainRecallFeasibility:
+    def test_recalled_pinned_task_finishes_on_draining_node(
+            self, monkeypatch):
+        """Regression for the recall/re-lease race: a task pinned by a
+        custom resource that exists ONLY on the draining node gets its
+        push refused (node_draining) — re-leasing it is infeasible, so
+        it must instead finish on the original node under the drain
+        deadline (the drain_final override). The race window (drain
+        landing while the push is in flight) is held open
+        deterministically with a server-side PushTask dispatch delay,
+        and looped: every iteration used to be a coin flip."""
+        monkeypatch.setenv("RAY_TPU_TESTING_RPC_FAILURE",
+                           "PushTask=1:300,PushTaskBatch=1:300")
+        for _ in range(2):
+            cluster = Cluster()
+            cluster.add_node(num_cpus=2)
+            n2 = cluster.add_node(num_cpus=2, resources={"n2": 10})
+            cluster.wait_for_nodes()
+            ray_tpu.init(address=cluster.address)
+            gcs = RpcClient("127.0.0.1", cluster.gcs_port)
+            try:
+                @ray_tpu.remote(max_retries=0, resources={"n2": 1})
+                def pinned(x):
+                    import time as _t
+
+                    _t.sleep(0.3)
+                    return x * 7
+
+                refs = [pinned.remote(i) for i in range(2)]
+                # wait for the leases to be GRANTED on n2 — the pushes
+                # are then in their injected 300ms dispatch delay, which
+                # is exactly the recall window
+                raylet2 = RpcClient("127.0.0.1", n2.raylet_port)
+                try:
+                    deadline = time.monotonic() + 30
+                    while time.monotonic() < deadline:
+                        if raylet2.call("GetState",
+                                        timeout=10)["num_leases"] >= 2:
+                            break
+                        time.sleep(0.05)
+                finally:
+                    raylet2.close()
+                drain_node(gcs, n2.node_id, deadline_s=20.0)
+                # no other node has {"n2": 1}: re-leasing would be
+                # infeasible and fail the task; drain_final must land
+                # it back on n2 before the node dies
+                assert ray_tpu.get(refs, timeout=120) == [0, 7]
+                _wait_drained(gcs, n2.node_id)
+            finally:
+                gcs.close()
+                try:
+                    ray_tpu.shutdown()
+                except Exception:  # noqa: BLE001
+                    pass
+                cluster.shutdown()

@@ -117,10 +117,15 @@ def test_actor_on_remote_node_and_cross_node_calls(three_node_cluster):
     )
 
 
-def test_survive_worker_node_death():
+def test_survive_worker_node_death(monkeypatch):
     """Kill a worker node: cluster marks it dead, objects it held are lost
     with a clear error, and new work schedules on survivors."""
     ray_tpu.shutdown()  # detach from the module fixture's cluster
+    # this tiny 2-node cluster can't gap heartbeats the way the 2k-actor
+    # bursts behind the 20-beat default do (config.py) — 6 beats keeps
+    # margin and cuts ~14s off the death-detection wait; the env var is
+    # what the spawned GCS reads at startup
+    monkeypatch.setenv("RAY_TPU_GCS_HEALTH_CHECK_FAILURE_THRESHOLD", "6")
     cluster = Cluster()
     cluster.add_node(num_cpus=2, resources={"head": 1})
     doomed = cluster.add_node(num_cpus=2, resources={"doomed": 1})

@@ -77,7 +77,7 @@ class TestBorrowedRefs:
         leaker = Leaker.remote()
         (dead,) = ray_tpu.get(leaker.make_dead_ref.remote())
         with pytest.raises(Exception):
-            ray_tpu.get(dead, timeout=15)
+            ray_tpu.get(dead, timeout=6)
 
     def test_plain_value_roundtrip_unaffected(self, ray_start_regular):
         @ray_tpu.remote

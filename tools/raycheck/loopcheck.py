@@ -217,15 +217,38 @@ def _inline_lambdas(mod: SourceModule) -> List[Tuple[str, ast.Lambda]]:
 
 
 _SERVE_PATH_PREFIXES = ("ray_tpu/serve/", "ray_tpu/llm/")
+# the podracer stream path carries the same no-unbounded-wait contract:
+# a draining actor or dead learner must surface as a timeout the fleet
+# can route around, never park a pump/train loop forever
+_STREAM_PATH_PREFIXES = ("ray_tpu/rllib/podracer/",)
+# channel verbs default to a BOUNDED timeout — only an explicit
+# timeout=None unbounds them
+_CHANNEL_WAIT_ATTRS = {"read", "read_view", "write"}
 # resolution calls that park the caller until a result arrives — on the
 # serve request path each must be bounded by the request deadline
 _SERVE_WAIT_ATTRS = {"result", "get", "wait", "acquire"}
 
 
-def _serve_wait_reason(mod: SourceModule,
-                       call: ast.Call) -> Optional[Tuple[str, str]]:
+def _channel_wait_reason(call: ast.Call) -> Optional[Tuple[str, str]]:
+    """(detail, reason) when this is a channel read/write explicitly
+    unbounded with timeout=None."""
+    attr = terminal_attr(call.func)
+    if attr not in _CHANNEL_WAIT_ATTRS:
+        return None
+    t = call_kwarg(call, "timeout")
+    if isinstance(t, ast.Constant) and t.value is None:
+        return (f"streampath:{attr}",
+                f"timeout=None on channel .{attr}() in the podracer "
+                "stream path — a dead peer parks the fleet forever; "
+                "keep the bounded default or derive one from the drain "
+                "deadline")
+    return None
+
+
+def _serve_wait_reason(mod: SourceModule, call: ast.Call,
+                       where: str = "serve") -> Optional[Tuple[str, str]]:
     """(detail, reason) when this call is an un-timeouted wait on the
-    serve/llm request path."""
+    serve/llm request path or the podracer stream path."""
     fn = call.func
     attr = terminal_attr(fn)
     if attr not in _SERVE_WAIT_ATTRS or _has_timeout(call):
@@ -234,7 +257,7 @@ def _serve_wait_reason(mod: SourceModule,
         # fut.result(5) / fut.result(timeout) positional counts as bounded
         if call.args:
             return None
-        return ("servepath:result", "un-timeouted .result() on the serve "
+        return ("servepath:result", f"un-timeouted .result() on the {where} "
                 "path — bound it by the request deadline "
                 "(slo.remaining_or(...))")
     if attr == "get":
@@ -242,8 +265,8 @@ def _serve_wait_reason(mod: SourceModule,
         # .get() shapes are covered by the async-def sweep where relevant
         if mod.resolves_to(fn, "ray_tpu", "get") and \
                 len(call.args) < 2:  # get(ref, timeout) positional is bounded
-            return ("servepath:get", "un-timeouted ray_tpu.get() on the "
-                    "serve path — bound it by the request deadline")
+            return ("servepath:get", f"un-timeouted ray_tpu.get() on the "
+                    f"{where} path — bound it by the request deadline")
         return None
     if attr == "wait":
         recv = (receiver_name(fn) or "").lower()
@@ -252,7 +275,7 @@ def _serve_wait_reason(mod: SourceModule,
                 fn, "asyncio", "wait") and "self" != recv:
             if call.args:  # wait(5) positional timeout
                 return None
-            return ("servepath:wait", "un-timeouted .wait() on the serve "
+            return ("servepath:wait", f"un-timeouted .wait() on the {where} "
                     "path — a dead peer parks this forever; derive a "
                     "timeout from the request deadline")
         return None
@@ -260,19 +283,24 @@ def _serve_wait_reason(mod: SourceModule,
         recv = (receiver_name(fn) or "").lower()
         if ("lock" in recv or "sem" in recv) and not call.args and \
                 call_kwarg(call, "blocking") is None:
-            return ("servepath:acquire", "un-timeouted acquire() on the "
-                    "serve path — bound it or use a with-block outside "
+            return ("servepath:acquire", f"un-timeouted acquire() on the "
+                    f"{where} path — bound it or use a with-block outside "
                     "the request path")
         return None
     return None
 
 
 def _check_serve_path(mod: SourceModule, findings: List[Finding]) -> None:
-    if not any(mod.relpath.startswith(p) for p in _SERVE_PATH_PREFIXES):
+    serve = any(mod.relpath.startswith(p) for p in _SERVE_PATH_PREFIXES)
+    stream = any(mod.relpath.startswith(p) for p in _STREAM_PATH_PREFIXES)
+    if not serve and not stream:
         return
+    where = "serve" if serve else "podracer stream"
     for node in mod.all_nodes:
         if isinstance(node, ast.Call):
-            hit = _serve_wait_reason(mod, node)
+            hit = _serve_wait_reason(mod, node, where)
+            if hit is None and stream:
+                hit = _channel_wait_reason(node)
             if hit is not None:
                 findings.append(Finding(
                     "RC001", mod.relpath, node.lineno, mod.scope_of(node),

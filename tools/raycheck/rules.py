@@ -229,15 +229,18 @@ _SHUTDOWN_FN_RE = re.compile(
 
 def _rc004_scope(mod: SourceModule) -> Tuple[bool, bool]:
     """(full_scope, tests) — full_scope enables every RC004 check
-    (chaos.py / drain.py / tests, plus the serve/llm request path: the
-    front door is chaos-tested under seeded churn, so unseeded
-    randomness or silently swallowed errors there break soak replay and
-    hide shed/retry bugs); elsewhere only the swallowed-exception check
-    applies, and only inside shutdown-path functions."""
+    (chaos.py / drain.py / tests, plus the serve/llm request path and
+    rllib: the front door is chaos-tested under seeded churn and RL
+    runs are seed-reproducible by contract — worker_seed fan-out — so
+    unseeded randomness or silently swallowed errors there break soak
+    replay and hide shed/retry bugs); elsewhere only the
+    swallowed-exception check applies, and only inside shutdown-path
+    functions."""
     base = os.path.basename(mod.relpath)
     in_tests = "tests/" in mod.relpath or base.startswith("test_") \
         or base == "conftest.py"
-    in_serve = mod.relpath.startswith(("ray_tpu/serve/", "ray_tpu/llm/"))
+    in_serve = mod.relpath.startswith(
+        ("ray_tpu/serve/", "ray_tpu/llm/", "ray_tpu/rllib/"))
     return (base in ("chaos.py", "drain.py") or in_tests or in_serve), \
         in_tests
 
