@@ -33,6 +33,11 @@ class RayTaskError(RayTpuError):
         try:
             class _cls(RayTaskError, cause_cls):  # type: ignore[misc, valid-type]
                 def __init__(self, inner: "RayTaskError"):
+                    # cause attributes first so callers can read the
+                    # typed payload (e.g. CollectiveRankFailure
+                    # .dead_ranks) off the wrapper; the wrapper's own
+                    # fields win on collision
+                    self.__dict__.update(inner.cause.__dict__)
                     self.__dict__.update(inner.__dict__)
                     Exception.__init__(self, str(inner))
 

@@ -363,6 +363,27 @@ class _PodLearnerImpl:
             self.weights_version += 1
 
     # -- collective sync (multi-learner) --------------------------------
+    def reset_group(self, group_name: str) -> bool:
+        """Rotate onto a fresh collective group (driver-directed, after a
+        learner death surfaced as :class:`CollectiveRankFailure`). The
+        old group's rendezvous actor may still hold state pinned to the
+        dead rank; a new group name gives every survivor — and the
+        respawned learner — a clean epoch-0 membership."""
+        if self.world <= 1:
+            self.group_name = group_name
+            return True
+        from ray_tpu.util import collective as col
+
+        try:
+            col.destroy_collective_group(self.group_name)
+        except Exception:  # noqa: BLE001 — old group is being abandoned
+            pass
+        self.group_name = group_name
+        col.init_collective_group(self.world, self.rank,
+                                  backend="objstore",
+                                  group_name=group_name)
+        return True
+
     def sync_params(self) -> int:
         """Cross-learner weight sync over the collective v2 broadcast
         path (objstore backend): rank 0's params fan out to every rank.
@@ -526,7 +547,9 @@ class Sebulba:
         self.iteration = 0
         self.app_errors = 0
         self.learner_restarts = 0
-        self._group_name = f"sebulba-{self._uid}"
+        self.group_rotations = 0
+        self._group_gen = 0
+        self._group_name = f"sebulba-{self._uid}-g0"
         self._slice_pg = None
         self._pgs: List[Any] = []
         if cfg.slice_topology:
@@ -745,11 +768,7 @@ class Sebulba:
                                         "restarted": True}
         if cfg.num_learners > 1 and \
                 self.iteration % max(1, cfg.sync_every_iterations) == 0:
-            sync_futs = [ln.sync_params.remote() for ln in self.learners]
-            try:
-                ray_tpu.get(sync_futs, timeout=120)
-            except Exception:  # noqa: BLE001
-                self.app_errors += 1
+            self._sync_learners()
         self.iteration += 1
         agg = [s for s in learner_stats if s]
         total_updates = sum(s.get("updates", 0) for s in agg)
@@ -762,6 +781,7 @@ class Sebulba:
             "live_actors": [s.index for s in self.fleet.live_actors()],
             "app_errors": self.app_errors,
             "learner_restarts": self.learner_restarts,
+            "group_rotations": self.group_rotations,
             "episode_return_mean": float(np.mean(
                 [s["episode_return_mean"] for s in agg
                  if s.get("episode_return_mean") is not None]))
@@ -769,6 +789,65 @@ class Sebulba:
             "learners": agg,
         }
         return out
+
+    # -- collective sync + group rotation -------------------------------
+    def _sync_learners(self) -> None:
+        """Cross-learner weight sync with elastic recovery. A learner
+        lost mid-broadcast no longer stalls the driver to the full
+        deadline: survivors raise :class:`CollectiveRankFailure` (or
+        :class:`CollectiveTimeoutError`) within the detection window and
+        the dead learner's own future fails with an actor error. Both
+        are MEMBERSHIP events, not app errors — the response is to
+        respawn the dead rank from its checkpoint and rotate the whole
+        fleet onto a fresh collective group generation."""
+        from ray_tpu.exceptions import RayActorError
+        from ray_tpu.util.collective import CollectiveError
+
+        sync_futs = {ln.sync_params.remote(): r
+                     for r, ln in enumerate(self.learners)}
+        membership_event = False
+        for fut, r in sync_futs.items():
+            try:
+                ray_tpu.get(fut, timeout=120)
+            except Exception as e:  # noqa: BLE001
+                if isinstance(e, (CollectiveError, RayActorError)):
+                    membership_event = True
+                else:
+                    self.app_errors += 1
+        if membership_event:
+            self._rotate_group()
+
+    def _rotate_group(self) -> None:
+        """Respawn dead learners from checkpoint and move every learner
+        onto a fresh group name (`-g{N}`): the old group's rendezvous
+        still carries the dead rank's pins, so survivors re-init into a
+        clean epoch-0 membership instead of waiting out a resize."""
+        self._group_gen += 1
+        self.group_rotations += 1
+        self._group_name = f"sebulba-{self._uid}-g{self._group_gen}"
+        survivors: List[int] = []
+        dead: List[int] = []
+        for r, learner in enumerate(self.learners):
+            try:
+                ray_tpu.get(learner.live_streams.remote(), timeout=10)
+                survivors.append(r)
+            except Exception:  # noqa: BLE001
+                dead.append(r)
+        # survivor resets are fired BEFORE the respawns and collected
+        # after: whichever side holds rank 0 creates the new group's
+        # rendezvous, and the other side's init waits for it — a
+        # sequential order would deadlock one of the two cases
+        reset_futs = [self.learners[r].reset_group.remote(self._group_name)
+                      for r in survivors]
+        for r in dead:  # respawn joins the rotated group via __init__
+            try:
+                self._spawn_learner(r, restore=True)
+            except Exception:  # noqa: BLE001
+                self.app_errors += 1
+        try:
+            ray_tpu.get(reset_futs, timeout=120)
+        except Exception:  # noqa: BLE001
+            self.app_errors += 1
 
     # -- lifecycle ------------------------------------------------------
     def save(self) -> int:

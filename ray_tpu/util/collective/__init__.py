@@ -1,8 +1,10 @@
 """Collective communication (reference: python/ray/util/collective)."""
 
 from ray_tpu.util.collective.collective import (
+    CollectiveHandle,
     allgather,
     allreduce,
+    async_allreduce,
     barrier,
     broadcast,
     create_collective_group,
@@ -15,13 +17,24 @@ from ray_tpu.util.collective.collective import (
     reducescatter,
     send,
 )
-from ray_tpu.util.collective.types import Backend, ReduceOp
+from ray_tpu.util.collective.types import (
+    Backend,
+    CollectiveError,
+    CollectiveRankFailure,
+    CollectiveTimeoutError,
+    ReduceOp,
+)
 
 __all__ = [
     "Backend",
+    "CollectiveError",
+    "CollectiveHandle",
+    "CollectiveRankFailure",
+    "CollectiveTimeoutError",
     "ReduceOp",
     "allgather",
     "allreduce",
+    "async_allreduce",
     "barrier",
     "broadcast",
     "create_collective_group",

@@ -11,6 +11,7 @@ the shared-memory object store)."""
 
 from __future__ import annotations
 
+import queue
 import threading
 from typing import Any, Dict, List, Optional
 
@@ -18,6 +19,92 @@ from ray_tpu.util.collective.types import Backend, ReduceOp
 
 _groups: Dict[str, Any] = {}
 _lock = threading.Lock()
+
+
+class CollectiveHandle:
+    """Future for one async collective op (:func:`async_allreduce`).
+
+    ``result(timeout)`` returns the op's output or re-raises its
+    failure (:class:`~.types.CollectiveRankFailure` /
+    :class:`~.types.CollectiveTimeoutError` included — the handle is
+    where the elastic retry signal surfaces). Always pass a timeout on
+    paths that must stay responsive (event handlers, drain callbacks):
+    a bare ``result()`` inherits the op deadline of the worker thread
+    plus queueing, which is unbounded under backlog — raycheck RC001
+    flags bare ``result()`` on handler-reachable paths for exactly this
+    reason."""
+
+    def __init__(self, op: str, group_name: str):
+        self.op = op
+        self.group_name = group_name
+        self._done = threading.Event()
+        self._value: Any = None
+        self._exc: Optional[BaseException] = None
+
+    def _finish(self, value: Any = None,
+                exc: Optional[BaseException] = None) -> None:
+        self._value = value
+        self._exc = exc
+        self._done.set()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"async collective {self.op} on group "
+                f"'{self.group_name}' not done within {timeout}s")
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+
+class _AsyncWorker:
+    """Per-group FIFO worker draining async collective submissions.
+
+    One daemon thread per group, lazily started: collective ops on one
+    group must stay strictly ordered (every member's op N is the same
+    op), so a single consumer IS the ordering guarantee — callers get
+    overlap (compute while the op runs), never reordering."""
+
+    def __init__(self, group_name: str):
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._run, name=f"collective-async-{group_name}",
+            daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, handle = item
+            try:
+                handle._finish(value=fn())
+            except BaseException as e:  # noqa: BLE001 — delivered via handle
+                handle._finish(exc=e)
+
+    def submit(self, fn, handle: CollectiveHandle) -> None:
+        self._q.put((fn, handle))
+
+    def stop(self) -> None:
+        self._q.put(None)
+        # bounded join: an in-flight op finishes its current leg before
+        # the sentinel is consumed; don't hang destroy on a wedged op
+        self._thread.join(timeout=5.0)
+
+
+_async_workers: Dict[str, _AsyncWorker] = {}
+
+
+def _async_worker(group_name: str) -> _AsyncWorker:
+    with _lock:
+        w = _async_workers.get(group_name)
+        if w is None:
+            w = _async_workers[group_name] = _AsyncWorker(group_name)
+        return w
 
 
 def init_collective_group(
@@ -72,6 +159,9 @@ def is_group_initialized(group_name: str = "default") -> bool:
 def destroy_collective_group(group_name: str = "default") -> None:
     with _lock:
         g = _groups.pop(group_name, None)
+        w = _async_workers.pop(group_name, None)
+    if w is not None:
+        w.stop()
     if g is not None and hasattr(g, "close"):
         try:
             g.close()
@@ -102,6 +192,26 @@ def _group(group_name: str):
 def allreduce(tensor: Any, group_name: str = "default",
               op: ReduceOp = ReduceOp.SUM):
     return _group(group_name).allreduce(tensor, op)
+
+
+def async_allreduce(tensor: Any, group_name: str = "default",
+                    op: ReduceOp = ReduceOp.SUM) -> CollectiveHandle:
+    """Submit an allreduce and return a :class:`CollectiveHandle`
+    immediately — the op runs on the group's async worker thread while
+    the caller computes. Submission order IS execution order (single
+    FIFO worker per group), so mixing async and sync ops is safe as
+    long as every member mixes them identically.
+
+    The tensor is snapshotted (copied) at submission: callers routinely
+    overwrite their buffer with the next step's values while the op is
+    in flight, and a live view would race the encode phase."""
+    import numpy as np
+
+    g = _group(group_name)
+    snap = np.array(tensor, copy=True)
+    handle = CollectiveHandle("allreduce", group_name)
+    _async_worker(group_name).submit(lambda: g.allreduce(snap, op), handle)
+    return handle
 
 
 def allgather(tensor: Any, group_name: str = "default"):

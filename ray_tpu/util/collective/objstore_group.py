@@ -9,18 +9,46 @@ and the data plane is chosen per op by the v2 selection table
 pipes for 2-rank groups, the hierarchical shm-arena + cross-host
 rendezvous composition for everything bigger, and the object store as
 the universal fallback.
+
+Fault model (PR 17 — the elastic/fail-fast layer; README "Collectives"
+documents the caller-visible contract):
+
+- The rendezvous actor doubles as the group's **membership authority**
+  (:mod:`..v2.membership`): it watches ``NODE_DRAIN_START`` events and
+  GCS actor state, and every public op pins an (epoch, members) pair
+  before touching any transport. A DRAINING rank finishes the ops it
+  already pinned and is excluded from every later one; survivors adopt
+  the bumped epoch at their next op and complete **degraded** —
+  reductions and gathers are over the survivor set.
+- Every wait is budgeted by the group-agreed op deadline
+  (``RAY_TPU_COLLECTIVE_OP_TIMEOUT_S``) and sliced so peer liveness is
+  cross-checked against the authority every ~0.5 s: a provably DEAD
+  peer raises :class:`CollectiveRankFailure` (naming the rank and
+  epoch) within the detection window instead of hanging; deadline
+  exhaustion raises :class:`CollectiveTimeoutError` carrying
+  op/phase/suspects. Both are retriable one epoch later — adoption
+  resets the internal sequence counters inside the new epoch's key
+  namespace, so a half-finished op can never splice into a later one.
 """
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 import ray_tpu
+from ray_tpu.experimental.channel import ChannelTimeoutError
 from ray_tpu.observability import collective as obs_col
-from ray_tpu.util.collective.types import ReduceOp
+from ray_tpu.observability import events as obs_events
+from ray_tpu.util.collective.types import (
+    CollectiveRankFailure,
+    CollectiveTimeoutError,
+    ReduceOp,
+)
+from ray_tpu.util.collective.v2.membership import GroupMembership
 
 _NUMPY_REDUCERS = {
     ReduceOp.SUM: lambda xs: np.sum(xs, axis=0),
@@ -35,7 +63,11 @@ _NUMPY_REDUCERS = {
 class _Rendezvous:
     """Collects one ObjectRef per participating rank per (key, op
     sequence number), releases the full set once every expected rank
-    contributed.
+    contributed — and serializes the group's MEMBERSHIP decisions
+    (:class:`GroupMembership`): every public op pins its (epoch,
+    members) here before touching a transport, and the authority scans
+    the drain bus + GCS actor state (rate-limited) so a dying rank is
+    resized out instead of wedging the group.
 
     GC contract (PR-11 satellite — the pre-v2 version leaked per-seq
     refs in >2-rank groups whenever a rank abandoned a sequence):
@@ -58,7 +90,103 @@ class _Rendezvous:
         # key -> {rank: highest seq that rank successfully collected}
         self._wm: Dict[str, Dict[int, int]] = {}
         self._max_live_per_key = 2 * world_size + 8
+        self._mem = GroupMembership(world_size)
+        self._last_scan = 0.0
+        self._drain_seen = 0
 
+    # -- membership authority ------------------------------------------
+    def _reset_incarnation(self, world_size: int):
+        """A new group incarnation reuses this (named, persistent)
+        actor: fresh membership ledger, fresh directory (p2p slots
+        excepted — undelivered old messages surviving a re-init is the
+        v1 in-flight-message semantics)."""
+        self.world_size = world_size
+        self._max_live_per_key = 2 * world_size + 8
+        self._mem = GroupMembership(world_size)
+        self._drain_seen = 0
+        self._wm.clear()
+        for ks in [ks for ks in self._slots
+                   if not ks[0].startswith("p2p_")]:
+            self._slots.pop(ks, None)
+
+    def _scan(self, force: bool = False):
+        """Observe the control plane: drain events flag members whose
+        node is leaving (graceful — they finish pinned ops), DEAD
+        actors are resized out immediately. Rate-limited: this actor's
+        message loop is the group's hot path."""
+        now = time.monotonic()
+        if now - self._last_scan < (0.2 if force else 0.4):
+            return
+        self._last_scan = now
+        from ray_tpu._private.drain import EVENT_DRAIN_START
+        from ray_tpu.util import state as rstate
+
+        leaving: set = set()
+        try:
+            events = rstate.list_events(etype=EVENT_DRAIN_START)
+            for ev in events[self._drain_seen:]:
+                nid = ev.get("node_id", "")
+                if nid:
+                    leaving.update(
+                        r for r in self._mem.members
+                        if self._mem.node_of.get(r) == nid)
+            self._drain_seen = len(events)
+        except Exception:  # noqa: BLE001 — bus unreachable: no event
+            pass
+        dead: set = set()
+        for r in self._mem.members:
+            aid = self._mem.actor_of.get(r)
+            if not aid:
+                continue
+            try:
+                info = rstate.get_actor(aid)
+            except Exception:  # noqa: BLE001
+                continue
+            if info and info.get("state") == "DEAD":
+                dead.add(r)
+        if dead:
+            self._mem.mark_dead(dead)
+        if leaving | dead:
+            self._mem.resize(leaving | dead)
+
+    def begin_op(self, op_seq: int, rank: int, world_size: int,
+                 actor_id: Optional[str] = None,
+                 node_id: Optional[str] = None) -> Tuple[int, List[int]]:
+        """Pin (epoch, members) for ``op_seq`` — decided by the first
+        arriving participant, immutable afterwards (membership.py has
+        the full protocol argument)."""
+        if world_size != self.world_size \
+                or self._mem.went_backwards(rank, op_seq):
+            self._reset_incarnation(world_size)
+        self._mem.register(rank, actor_id, node_id)
+        self._scan()
+        epoch, members = self._mem.pin(op_seq, rank)
+        return epoch, list(members)
+
+    def liveness(self, ranks: Optional[List[int]] = None) -> dict:
+        """Force a control-plane scan and report confirmed-dead ranks
+        (all-time for this incarnation, intersected with ``ranks``)."""
+        self._scan(force=True)
+        dead = self._mem.dead if ranks is None \
+            else self._mem.dead & set(ranks)
+        return {"dead": sorted(dead), "epoch": self._mem.epoch,
+                "members": list(self._mem.members)}
+
+    def fence(self) -> int:
+        """Epoch bump with no membership change — the post-timeout
+        counter-realignment barrier."""
+        return self._mem.fence()
+
+    def membership_view(self) -> dict:
+        return self._mem.view()
+
+    def missing(self, key: str, seq: int, ranks: List[int]) -> List[int]:
+        """Expected participants that have not put (key, seq) yet —
+        the suspect list for timeout diagnostics and liveness probes."""
+        slot = self._slots.get((key, seq), {})
+        return [r for r in ranks if r not in slot]
+
+    # -- directory ------------------------------------------------------
     def put(self, key: str, seq: int, rank: int, ref: Any,
             world_size: Optional[int] = None):
         if world_size is not None and world_size != self.world_size:
@@ -66,12 +194,7 @@ class _Rendezvous:
             # differently than the incarnation that created this actor
             # IS a new incarnation — adopt the new world (collect()'s
             # expected set must match it) and reset the directory
-            self.world_size = world_size
-            self._max_live_per_key = 2 * world_size + 8
-            self._wm.clear()
-            for ks in [ks for ks in self._slots
-                       if not ks[0].startswith("p2p_")]:
-                self._slots.pop(ks, None)
+            self._reset_incarnation(world_size)
         if self._wm.get(key, {}).get(rank, -1) >= seq:
             # a rank re-putting a sequence it already collected means a
             # NEW group incarnation reuses this (named, persistent)
@@ -116,7 +239,7 @@ class _Rendezvous:
         """Full set for (key, seq) in participant order, or None while
         incomplete. ``ranks`` names the expected participants (default:
         the whole group) — the hier cross-host phase exchanges among
-        counterpart subsets."""
+        counterpart subsets, degraded epochs among survivors."""
         expected = tuple(ranks) if ranks is not None \
             else tuple(range(self.world_size))
         slot = self._slots.get((key, seq), {})
@@ -192,6 +315,14 @@ class ObjStoreGroup:
     fixed-shape meta channel (same host) or the object path (cross
     host) — divergent shapes degrade to the object path, never
     deadlock.
+
+    Elasticity (PR 17): ``rank``/``world_size`` are the group's BIRTH
+    coordinates and never change; ``members`` is the current epoch's
+    survivor tuple and ``_eff_rank``/``_eff_world`` this rank's dense
+    position in it. Transports, topology, policy and sequence counters
+    are all per-epoch: :meth:`_adopt` tears them down and the next op
+    lazily rebuilds them among the survivors, inside the new epoch's
+    rendezvous key namespace (``e{epoch}:...``).
     """
 
     def __init__(self, world_size: int, rank: int, group_name: str = "default"):
@@ -199,10 +330,15 @@ class ObjStoreGroup:
         self.rank = rank
         self.group_name = group_name
         self._seq = 0
+        self._op_seq = 0
+        self._epoch = 0
+        self._members: Tuple[int, ...] = tuple(range(world_size))
+        self._eff_rank = rank
+        self._eff_world = world_size
         self._p2p_seqs: Dict[str, int] = {}
         self._sub_seqs: Dict[str, int] = {}
-        # (shape, dtype) -> (my_channel, [(rank, reader), ...]) or None
-        # (None = cross-host group: stay on the object path)
+        # (shape, dtype) -> (my_channel, [(eff_rank, reader), ...]) or
+        # None (None = cross-host group: stay on the object path)
         self._channels: Dict[Tuple, Optional[Tuple[Any, List]]] = {}
         # fixed-shape metadata channels for the per-op routing agreement
         # (() = not yet set up, None = cross-host: channel plane off)
@@ -216,6 +352,15 @@ class ObjStoreGroup:
         # size-bucketed host-local ShmArenas (v2 intra-host transport)
         self._arenas: Dict[int, Any] = {}
         self._exec = None
+        # simulated-WAN link state: when the sender's next byte may
+        # start crossing (serializes the capped cross-host leg)
+        self._wan_free_t = 0.0
+        # resolved lazily (_identity): groups are built in actor
+        # __init__, where the creation task's context has NO actor id
+        # yet — capturing here would register None with the authority
+        # and blind its GCS liveness cross-check for the whole group
+        self._my_actor_id: Optional[str] = None
+        self._my_node_id: Optional[str] = None
         name = f"__collective_rdv_{group_name}"
         if rank == 0:
             try:
@@ -227,6 +372,21 @@ class ObjStoreGroup:
         else:
             self._rdv = self._wait_for_actor(name)
 
+    def _identity(self) -> Tuple[Optional[str], Optional[str]]:
+        """(actor_id, node_id) of this rank, resolved on first use from
+        a METHOD-call context — the ids the authority cross-checks
+        against GCS when deciding a suspect is confirmed dead."""
+        if self._my_actor_id is None or self._my_node_id is None:
+            try:
+                ctx = ray_tpu.get_runtime_context()
+                if self._my_actor_id is None:
+                    self._my_actor_id = ctx.get_actor_id()
+                if self._my_node_id is None:
+                    self._my_node_id = ctx.get_node_id()
+            except Exception:  # noqa: BLE001 — driver-side groups
+                pass
+        return self._my_actor_id, self._my_node_id
+
     @staticmethod
     def _wait_for_actor(name: str, timeout: float = 30.0):
         deadline = time.time() + timeout
@@ -237,94 +397,346 @@ class ObjStoreGroup:
                 time.sleep(0.05)
         raise TimeoutError(f"collective rendezvous actor {name} not found")
 
+    # -- membership / epochs -------------------------------------------
+    @property
+    def members(self) -> Tuple[int, ...]:
+        """Global ranks alive at the adopted epoch."""
+        return self._members
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    def _op_timeout_s(self) -> float:
+        """The deadline budget for any single op leg: group-agreed once
+        the policy exchange ran (min across ranks — whoever wants to
+        fail fastest wins), this rank's env before that."""
+        if self._policy2 is not None:
+            return self._policy2.op_timeout_s
+        try:
+            return max(0.1, float(os.environ.get(
+                "RAY_TPU_COLLECTIVE_OP_TIMEOUT_S", "120") or 120.0))
+        except ValueError:
+            return 120.0
+
+    def _key(self, key: str) -> str:
+        """Epoch-namespaced rendezvous key. Epoch 0 keeps the bare key:
+        the non-degraded wire format is unchanged."""
+        return f"e{self._epoch}:{key}" if self._epoch else key
+
+    def _probe_dead(self, ranks=None) -> Tuple[int, ...]:
+        """Confirmed-dead ranks among ``ranks`` (authority cross-checks
+        GCS actor state). Best-effort: an unreachable authority means
+        no verdict, never an exception out of a wait loop."""
+        if self.world_size <= 1:
+            return ()
+        try:
+            res = ray_tpu.get(self._rdv.liveness.remote(
+                list(ranks) if ranks is not None else None))
+        except Exception:  # noqa: BLE001
+            return ()
+        return tuple(res.get("dead", ()))
+
+    def _fence(self) -> None:
+        """Ask the authority for an epoch bump with no membership
+        change: after a timeout the group's internal counters may be
+        skewed mid-op, and adoption at the next op resets them."""
+        try:
+            ray_tpu.get(self._rdv.fence.remote())
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _adopt(self, epoch: int, members) -> None:
+        """Adopt a new membership epoch: tear down every per-epoch
+        transport and counter; the next op lazily rebuilds them among
+        the survivors inside the new key namespace. This is also the
+        re-alignment point after failures — survivors may have left a
+        wedged op with skewed `_seq`/`_sub_seqs`, and resetting them
+        inside a FRESH namespace makes the skew unobservable."""
+        members = tuple(members)
+        if epoch == self._epoch and members == self._members:
+            return
+        self.close()
+        self._policy2 = None
+        self._topology = None
+        self._exec = None
+        self._seq = 0
+        self._sub_seqs.clear()
+        self._epoch = int(epoch)
+        self._members = members
+        self._eff_rank = members.index(self.rank) \
+            if self.rank in members else -1
+        self._eff_world = len(members)
+        try:
+            obs_events.record_event(
+                "collective_epoch", group=self.group_name,
+                epoch=self._epoch, rank=self.rank,
+                members=list(members))
+        except Exception:  # noqa: BLE001 — observability must not fail ops
+            pass
+
+    def _begin_op(self) -> None:
+        """Pin this op's (epoch, members) at the authority and adopt
+        any resize. Raises :class:`CollectiveRankFailure` naming THIS
+        rank when it has been drained/removed — the signal that it left
+        the group and must stop issuing collective ops."""
+        if self.world_size <= 1:
+            return
+        seq = self._op_seq
+        self._op_seq += 1
+        aid, nid = self._identity()
+        try:
+            epoch, members = ray_tpu.get(self._rdv.begin_op.remote(
+                seq, self.rank, self.world_size, aid, nid))
+        except Exception:  # noqa: BLE001 — authority unreachable: keep
+            return         # the current view; waits still budget out
+        members = tuple(members)
+        if self.rank not in members:
+            raise CollectiveRankFailure(
+                (self.rank,), epoch, self.group_name,
+                op="membership", phase="begin_op")
+        if (epoch, members) != (self._epoch, self._members):
+            self._adopt(epoch, members)
+
+    def _eff_to_global(self, eff_ranks) -> List[int]:
+        return [self._members[i] for i in eff_ranks]
+
     # ------------------------------------------------------------------
-    def _poll_collect(self, what: str, fn) -> List[Any]:
+    def _poll_collect(self, what: str, fn, *, op: str = "",
+                      phase: str = "", ranks=None,
+                      missing_fn=None) -> List[Any]:
         """Poll ``fn`` (a collect RPC returning the ref set or None)
         with progressive backoff: each poll is a full RPC round trip
         that costs CPU on both ends — on oversubscribed hosts a fixed
         2 ms cadence steals the very cycles the slow peer needs to
-        reach its put (measured 2x+ on the hier xh phase)."""
-        deadline = time.time() + 120.0
+        reach its put (measured 2x+ on the hier xh phase).
+
+        Deadline-budgeted and liveness-checked: every ~0.5 s the ranks
+        still missing (``missing_fn``, falling back to ``ranks``) are
+        cross-checked against GCS actor state via the authority — a
+        confirmed death raises :class:`CollectiveRankFailure` within
+        the detection window; deadline exhaustion fences the epoch and
+        raises :class:`CollectiveTimeoutError` with the suspects."""
+        timeout = self._op_timeout_s()
+        deadline = time.monotonic() + timeout
+        probe_at = time.monotonic() + min(0.5, timeout / 4)
         nap = 0.002
-        while time.time() < deadline:
+        while time.monotonic() < deadline:
             refs = fn()
             if refs is not None:
-                return [ray_tpu.get(r[0]) for r in refs]
+                # the value fetch stays under the op deadline too: a
+                # dangling ref (owner died between put and fetch) must
+                # surface as the typed timeout, not an unbounded get
+                left = max(0.1, deadline - time.monotonic())
+                try:
+                    return [ray_tpu.get(r[0], timeout=left) for r in refs]
+                except Exception:  # noqa: BLE001 — GetTimeoutError et al.
+                    break
+            if time.monotonic() >= probe_at:
+                probe_at = time.monotonic() + 0.5
+                waiting = None
+                if missing_fn is not None:
+                    try:
+                        waiting = missing_fn()
+                    except Exception:  # noqa: BLE001
+                        waiting = None
+                if waiting is None and ranks is not None:
+                    waiting = [r for r in ranks if r != self.rank]
+                dead = self._probe_dead(waiting)
+                if dead:
+                    raise CollectiveRankFailure(
+                        dead, self._epoch, self.group_name,
+                        op=op or what, phase=phase)
             time.sleep(nap)
             nap = min(nap * 1.5, 0.008)
-        raise TimeoutError(f"collective {what} timed out")
+        suspects: Tuple[int, ...] = ()
+        if missing_fn is not None:
+            try:
+                suspects = tuple(missing_fn())
+            except Exception:  # noqa: BLE001
+                pass
+        self._fence()
+        raise CollectiveTimeoutError(op or what, phase or "collect",
+                                     timeout, suspects, self.group_name)
 
+    def _guarded_wait(self, fn, op: str, phase: str, ranks=None):
+        """Run a blocking shm wait (``fn(slice_timeout)``) under the op
+        deadline, slicing it so peer liveness is probed between slices.
+        Every wrapped wait fails BEFORE mutating its endpoint (asserted
+        by reading channel.py/arena.py), so re-issuing after a slice
+        timeout is safe."""
+        timeout = self._op_timeout_s()
+        deadline = time.monotonic() + timeout
+        while True:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                self._fence()
+                raise CollectiveTimeoutError(
+                    op, phase, timeout, tuple(ranks or ()),
+                    self.group_name)
+            try:
+                return fn(min(0.6, max(0.05, left)))
+            except ChannelTimeoutError:
+                dead = self._probe_dead(ranks)
+                if dead:
+                    raise CollectiveRankFailure(
+                        dead, self._epoch, self.group_name,
+                        op=op, phase=phase)
+
+    # -- simulated WAN (bandwidth-capped cross-host leg) ----------------
+    def _wan_stamp(self, value: Any) -> Any:
+        """With ``RAY_TPU_COLLECTIVE_WAN_GBPS`` agreed on, stamp a
+        cross-host payload with the wall time its last byte clears the
+        simulated link (one serialized link per sending rank). The
+        receiver sleeps until the stamp — so wire time that a sender
+        overlapped with compute is genuinely hidden, and a codec that
+        sends fewer bytes genuinely finishes earlier. Applied ONLY to
+        the hier cross-host leg; intra-host shm is never capped."""
+        bw = self._policy2.wan_gbps if self._policy2 is not None else 0.0
+        if bw <= 0:
+            return value
+        nbytes = int(getattr(value, "nbytes", 0) or 0)
+        now = time.time()
+        start = now if now > self._wan_free_t else self._wan_free_t
+        ready = start + nbytes / (bw * 1e9 / 8.0)
+        self._wan_free_t = ready
+        return ("__wan__", ready, value)
+
+    def _wan_unwrap(self, vals: List[Any], senders: List[int]) -> List[Any]:
+        out: List[Any] = []
+        ready = 0.0
+        for r, v in zip(senders, vals):
+            if isinstance(v, tuple) and len(v) == 3 and v[0] == "__wan__":
+                if r != self.rank and v[1] > ready:
+                    ready = v[1]
+                v = v[2]
+            out.append(v)
+        delay = ready - time.time()
+        if delay > 0:
+            time.sleep(delay)
+        return out
+
+    # ------------------------------------------------------------------
     def _rdv_exchange(self, key: str, seq: int, value: Any,
-                      ranks: Optional[List[int]] = None) -> List[Any]:
+                      ranks: Optional[List[int]] = None, op: str = "",
+                      phase: str = "") -> List[Any]:
         """Put my value for (key, seq) and poll-collect every expected
-        participant's (default: the whole group)."""
+        participant's (default: the current epoch's members)."""
+        expected = list(ranks) if ranks is not None else list(self._members)
+        pkey = self._key(key)
         ref = ray_tpu.put(value)
-        ray_tpu.get(self._rdv.put.remote(key, seq, self.rank, [ref],
+        ray_tpu.get(self._rdv.put.remote(pkey, seq, self.rank, [ref],
                                          world_size=self.world_size))
         return self._poll_collect(
             f"{key} (seq={seq})",
             lambda: ray_tpu.get(
-                self._rdv.collect.remote(key, seq, self.rank, ranks)))
+                self._rdv.collect.remote(pkey, seq, self.rank, expected)),
+            op=op or key, phase=phase, ranks=expected,
+            missing_fn=lambda: ray_tpu.get(
+                self._rdv.missing.remote(pkey, seq, expected)))
 
-    def _exchange(self, key: str, value: Any) -> List[Any]:
+    def _exchange(self, key: str, value: Any, op: str = "",
+                  phase: str = "") -> List[Any]:
         seq = self._seq
         self._seq += 1
-        return self._rdv_exchange(key, seq, value)
+        return self._rdv_exchange(key, seq, value, op=op, phase=phase)
 
-    def _sub_exchange(self, key: str, value: Any,
-                      ranks: List[int]) -> List[Any]:
-        """Object-path exchange among ``ranks`` only (the hier
-        cross-host phase): every participant's value, in ``ranks``
-        order. Participants must all call with identical (key, ranks);
-        per-key sequence counters keep repeated phases aligned without
-        touching the group-wide counter."""
+    def _sub_put(self, key: str, value: Any, eff_ranks: List[int],
+                 op: str = "", phase: str = "") -> tuple:
+        """Async half of :meth:`_sub_exchange`: publish my value for
+        this key's next sequence and return a handle for
+        :meth:`_sub_collect`. The split is what the overlapped chunked
+        path pipelines on — block k's wire time hides behind block
+        k+1's reduction."""
+        ranks = self._eff_to_global(eff_ranks)
         assert self.rank in ranks
         seq = self._sub_seqs.get(key, 0)
         self._sub_seqs[key] = seq + 1
-        return self._rdv_exchange(key, seq, value, list(ranks))
+        pkey = self._key(key)
+        ref = ray_tpu.put(self._wan_stamp(value))
+        fut = self._rdv.put.remote(pkey, seq, self.rank, [ref],
+                                   world_size=self.world_size)
+        # ref MUST ride in the handle: until the rendezvous actor has
+        # processed the put (and pinned the object as a borrower),
+        # this local reference is the only thing keeping the object
+        # alive — dropping it early races the borrower registration
+        # and a collector can hang on a dangling ref
+        return (pkey, key, seq, ranks, fut, ref, op, phase)
+
+    def _sub_collect(self, handle: tuple) -> List[Any]:
+        pkey, key, seq, ranks, fut, _ref, op, phase = handle
+        ray_tpu.get(fut)  # surface put-side failures (directory assert)
+        vals = self._poll_collect(
+            f"{key} (seq={seq})",
+            lambda: ray_tpu.get(
+                self._rdv.collect.remote(pkey, seq, self.rank, ranks)),
+            op=op or key, phase=phase or "xh", ranks=ranks,
+            missing_fn=lambda: ray_tpu.get(
+                self._rdv.missing.remote(pkey, seq, ranks)))
+        return self._wan_unwrap(vals, ranks)
+
+    def _sub_exchange(self, key: str, value: Any, eff_ranks: List[int],
+                      op: str = "", phase: str = "") -> List[Any]:
+        """Object-path exchange among ``eff_ranks`` (EFFECTIVE indices
+        into the current members — the hier cross-host phase): every
+        participant's value, in that order. Participants must all call
+        with identical (key, eff_ranks); per-key sequence counters keep
+        repeated phases aligned without touching the group-wide
+        counter."""
+        return self._sub_collect(
+            self._sub_put(key, value, eff_ranks, op=op, phase=phase))
 
     def _scatter_exchange(self, key: str, per_dest: Dict[int, Any],
-                          ranks: List[int]) -> List[Any]:
-        """Pairwise scatter among ``ranks``: each participant publishes
-        one value PER destination and receives one value from every
-        other participant (sender order: ``ranks`` minus self). O(k)
-        bytes per rank where a dict over ``_sub_exchange`` would ship
-        O(k^2) — every peer would pull every other pair's shards just
-        to read its own entry."""
+                          eff_ranks: List[int], op: str = "",
+                          phase: str = "") -> List[Any]:
+        """Pairwise scatter among ``eff_ranks`` (effective indices):
+        each participant publishes one value PER destination and
+        receives one value from every other participant (sender order:
+        ``eff_ranks`` minus self). O(k) bytes per rank where a dict
+        over ``_sub_exchange`` would ship O(k^2) — every peer would
+        pull every other pair's shards just to read its own entry."""
+        ranks = self._eff_to_global(eff_ranks)
         assert self.rank in ranks
         seq = self._sub_seqs.get(key, 0)
         self._sub_seqs[key] = seq + 1
-        for dest, val in per_dest.items():
-            ref = ray_tpu.put(val)
+        for dest_eff, val in per_dest.items():
+            dest = self._members[dest_eff]
+            ref = ray_tpu.put(self._wan_stamp(val))
             ray_tpu.get(self._rdv.put.remote(
-                f"{key}>{dest}", seq, self.rank, [ref],
+                self._key(f"{key}>{dest}"), seq, self.rank, [ref],
                 world_size=self.world_size))
         senders = [r for r in ranks if r != self.rank]
-        return self._poll_collect(
+        mykey = self._key(f"{key}>{self.rank}")
+        vals = self._poll_collect(
             f"scatter {key} (seq={seq})",
             lambda: ray_tpu.get(self._rdv.collect_scatter.remote(
-                f"{key}>{self.rank}", seq, senders)))
+                mykey, seq, senders)),
+            op=op or key, phase=phase or "xh", ranks=senders,
+            missing_fn=lambda: ray_tpu.get(
+                self._rdv.missing.remote(mykey, seq, senders)))
+        return self._wan_unwrap(vals, senders)
 
     # -- group policy + topology (v2) ----------------------------------
     def _ensure_policy(self):
-        """Agree the v2 policy AND topology across the group, once:
-        every rank contributes its env knobs plus its host key, the
-        merge is deterministic and conservative (see policy.py), and
-        the per-op routing decision is then identical on all ranks by
-        construction — divergent env vars degrade throughput, never
-        deadlock the rendezvous."""
+        """Agree the v2 policy AND topology across the group, once per
+        epoch: every member contributes its env knobs plus its host
+        key, the merge is deterministic and conservative (see
+        policy.py), and the per-op routing decision is then identical
+        on all members by construction — divergent env vars degrade
+        throughput, never deadlock the rendezvous."""
         if self._policy2 is not None:
             return self._policy2
         from ray_tpu.util.collective.v2 import policy as policy_mod
         from ray_tpu.util.collective.v2 import topology as topo_mod
 
         mine = tuple(policy_mod.local_knobs()) + (topo_mod.node_key(),)
-        if self.world_size > 1:
-            infos = [tuple(i) for i in self._exchange("policy_v2", mine)]
+        if self._eff_world > 1:
+            infos = [tuple(i) for i in self._exchange(
+                "policy_v2", mine, op="setup", phase="policy")]
         else:
             infos = [mine]
         self._policy2 = policy_mod.merge_knobs([i[:-1] for i in infos])
-        self._topology = topo_mod.Topology(self.rank,
+        self._topology = topo_mod.Topology(self._eff_rank,
                                            [i[-1] for i in infos])
         return self._policy2
 
@@ -340,9 +752,9 @@ class ObjStoreGroup:
         """Host-local ShmArena with slots and region each >= nbytes,
         bucketed to powers of two so every message size maps to a small
         set of arenas. The local leader creates; names travel through
-        one world-wide exchange (every rank reaches the same rendezvous
-        key regardless of host), then each rank keeps its host
-        leader's arena."""
+        one member-wide exchange (every member reaches the same
+        rendezvous key regardless of host), then each member keeps its
+        host leader's arena."""
         bucket = 1 << max(12, int(nbytes - 1).bit_length()) \
             if nbytes > 1 else 4096
         ar = self._arenas.get(bucket)
@@ -356,7 +768,8 @@ class ObjStoreGroup:
             ar = ShmArena(topo.local_world, topo.local_rank, bucket,
                           bucket, create=True)
             name = ar.name
-        infos = self._exchange(f"arenasetup_{bucket}", name)
+        infos = self._exchange(f"arenasetup_{bucket}", name,
+                               op="setup", phase="arena")
         if not topo.is_local_leader:
             leader_name = infos[topo.leader(topo.my_host)]
             ar = ShmArena(topo.local_world, topo.local_rank, bucket,
@@ -365,10 +778,14 @@ class ObjStoreGroup:
         return ar
 
     # -- shared-memory channel data plane ------------------------------
+    def _peer_globals(self) -> List[int]:
+        return [r for r in self._members if r != self.rank]
+
     def _make_channel_set(self, shape, dtype, rdv_key: str):
-        """One object-path exchange advertises every rank's channel;
-        returns (my_channel, [(rank, reader), ...]) or None when the
-        group spans hosts or the advertised (shape, dtype) disagree."""
+        """One object-path exchange advertises every member's channel;
+        returns (my_channel, [(eff_rank, reader), ...]) or None when
+        the members span hosts or the advertised (shape, dtype)
+        disagree."""
         import socket
 
         from ray_tpu.experimental.channel import (
@@ -379,28 +796,30 @@ class ObjStoreGroup:
         key = (tuple(shape), str(dtype))
         host = socket.gethostname()
         mine = TensorChannel(shape, str(dtype),
-                             num_readers=self.world_size - 1)
-        infos = self._exchange(rdv_key, (host, key, mine.name))
+                             num_readers=self._eff_world - 1)
+        infos = self._exchange(rdv_key, (host, key, mine.name),
+                               op="setup", phase="channels")
         if any(h != host or k != key for h, k, _ in infos):
             mine.close()
             return None
         readers: List[Tuple[int, Any]] = []
         for r, (_h, _k, nm) in enumerate(infos):
-            if r == self.rank:
+            if r == self._eff_rank:
                 continue
-            # reader slot within rank r's channel: peers in rank order,
-            # skipping r itself
-            ridx = self.rank if self.rank < r else self.rank - 1
+            # reader slot within member r's channel: peers in member
+            # order, skipping r itself
+            ridx = self._eff_rank if self._eff_rank < r \
+                else self._eff_rank - 1
             readers.append((r, TensorChannelReader(
-                nm, shape, str(dtype), self.world_size - 1, ridx)))
+                nm, shape, str(dtype), self._eff_world - 1, ridx)))
         return (mine, readers)
 
     def _ensure_meta_channels(self):
         """Fixed-shape (int64[2]) channels for the PER-OP routing
         agreement. Set up through one shape-INDEPENDENT rendezvous
-        ("metasetup") the first time any rank tries the channel plane —
-        every rank reaches it regardless of tensor shapes, so setup
-        itself can't split across keys. None = the ranks span real
+        ("metasetup") the first time any member tries the channel plane
+        — every member reaches it regardless of tensor shapes, so setup
+        itself can't split across keys. None = the members span real
         hosts: the channel plane is off and per-op agreement falls back
         to the object path."""
         if self._meta == ():
@@ -414,10 +833,11 @@ class ObjStoreGroup:
             return st
         st = self._make_channel_set(shape, dtype, "chsetup")
         if st is None and self._meta is not None:
-            # shape-signature collision let mismatched ranks through the
-            # meta agreement (same host, or this would be the cross-host
-            # branch): don't cache — caching None per-rank under
-            # DIFFERENT keys would desync the next chsetup rendezvous
+            # shape-signature collision let mismatched members through
+            # the meta agreement (same host, or this would be the
+            # cross-host branch): don't cache — caching None per-rank
+            # under DIFFERENT keys would desync the next chsetup
+            # rendezvous
             return None
         self._channels[key] = st
         return st
@@ -433,51 +853,58 @@ class ObjStoreGroup:
         pipelined ring), "hier" (v2 hierarchical arena + cross-host
         composition) or "object" (rendezvous actor + object store).
 
-        The routing must be decided IDENTICALLY on every rank, but it
+        The routing must be decided IDENTICALLY on every member, but it
         depends on per-rank state — the tensor's shape/size. So every
         op first exchanges (shape-sig, nbytes): over a fixed-shape meta
-        channel when the ranks share a host (a couple of seqlock shm
+        channel when the members share a host (a couple of seqlock shm
         reads, no actor round-trips), over the object path when they
         don't (the cross-host phases dwarf one actor round-trip). Every
-        rank then applies the same selection table to the same vector:
-        all metas equal → policy.select_algorithm decides; anything
-        else → everyone takes the object path. Without the per-op
-        agreement, mismatched-shape ops after a matching warm-up, or
-        ops straddling a size threshold, would deadlock both sides for
-        the full 120s and desync the exchange seq (advisor finding)."""
+        member then applies the same selection table to the same
+        vector: all metas equal → policy.select_algorithm decides;
+        anything else → everyone takes the object path. Without the
+        per-op agreement, mismatched-shape ops after a matching
+        warm-up, or ops straddling a size threshold, would deadlock
+        both sides for the full op deadline and desync the exchange seq
+        (advisor finding)."""
         from ray_tpu.util.collective.v2 import policy as policy_mod
 
         pol = self._ensure_policy()
         topo = self._topology
-        if self.world_size <= 1 or not pol.channels_enabled:
+        if self._eff_world <= 1 or not pol.channels_enabled:
             return "object"  # group-agreed constants: identical everywhere
         # NOTE: no per-rank early returns below this line — dtype rides
         # in the shape signature and select_algorithm's non-numeric
-        # check, so even a rank holding a different/non-numeric dtype
+        # check, so even a member holding a different/non-numeric dtype
         # participates in the agreement and degrades WITH the group
         meta = self._ensure_meta_channels()
         sig = np.array([self._shape_sig(arr), arr.nbytes], np.int64)
         if meta is not None:
             meta_ch, meta_readers = meta
-            meta_ch.write(sig, timeout=120.0)
+            peers = self._peer_globals()
+            self._guarded_wait(
+                lambda t: meta_ch.write(sig, timeout=t),
+                op_kind, "route_write", ranks=peers)
             agree = True
-            for _r, rd in meta_readers:
-                peer = rd.read(timeout=120.0)
+            for r, rd in meta_readers:
+                peer = self._guarded_wait(
+                    lambda t, rd=rd: rd.read(timeout=t),
+                    op_kind, "route_read", ranks=[self._members[r]])
                 if peer[0] != sig[0] or peer[1] != sig[1]:
                     agree = False  # keep reading: drain every peer's slot
             if not agree:
                 return "object"  # same decision everywhere, by construction
         else:
-            # ranks span real hosts: only the hier plane is on the
+            # members span real hosts: only the hier plane is on the
             # table. Short-circuit every SIZE-INDEPENDENT "object"
             # answer (op kind, flat override, non-uniform topology)
             # before paying the agreement round trip — size-dependent
-            # decisions must exchange first or ranks straddling a
+            # decisions must exchange first or members straddling a
             # threshold would split
             if topo.single_host or not topo.uniform \
                     or pol.algo == "flat" or op_kind == "allgather":
                 return "object"
-            infos = self._exchange("hiermeta", (int(sig[0]), int(sig[1])))
+            infos = self._exchange("hiermeta", (int(sig[0]), int(sig[1])),
+                                   op=op_kind, phase="route")
             if any(tuple(i) != (int(sig[0]), int(sig[1])) for i in infos):
                 return "object"
         return policy_mod.select_algorithm(arr.nbytes, arr.dtype, topo, pol,
@@ -486,20 +913,25 @@ class ObjStoreGroup:
     def _channel_parts(self, arr: np.ndarray) -> Optional[List[np.ndarray]]:
         """Small-tensor plane: write mine once, read every peer's.
         None = channel setup detected a shape-signature collision —
-        symmetric on all ranks (the chsetup exchange shows everyone the
-        same mismatch), so every rank falls back together."""
+        symmetric on all members (the chsetup exchange shows everyone
+        the same mismatch), so every member falls back together.
+        Parts come back in MEMBER order (length = effective world)."""
         st = self._ensure_channels(arr.shape, arr.dtype)
         if st is None:
             return None
         mine, readers = st
-        mine.write(arr, timeout=120.0)
-        parts: List[Any] = [None] * self.world_size
+        self._guarded_wait(
+            lambda t: mine.write(arr, timeout=t),
+            "channel", "write", ranks=self._peer_globals())
+        parts: List[Any] = [None] * self._eff_world
         # own part is a COPY: the object path returned independent
         # buffers, and callers may mutate the gathered list in place —
         # aliasing the caller's live tensor would corrupt it
-        parts[self.rank] = arr.copy()
+        parts[self._eff_rank] = arr.copy()
         for r, rd in readers:
-            parts[r] = rd.read(timeout=120.0)
+            parts[r] = self._guarded_wait(
+                lambda t, rd=rd: rd.read(timeout=t),
+                "channel", "read", ranks=[self._members[r]])
         return parts
 
     # -- pipelined ring plane (large tensors) ---------------------------
@@ -507,10 +939,10 @@ class ObjStoreGroup:
 
     def _ensure_pipes(self):
         """Ring pipes, one per edge: my ChunkPipe feeds my successor
-        (rank+1), I read my predecessor's. Established through one
+        (next member), I read my predecessor's. Established through one
         object-path exchange the first time any op routes "pipe" (the
-        routing agreement guarantees every rank arrives); None = the
-        group spans hosts — cached, all ranks fall back together."""
+        routing agreement guarantees every member arrives); None = the
+        members span hosts — cached, all members fall back together."""
         if self._pipes != ():
             return self._pipes
         import socket
@@ -521,14 +953,15 @@ class ObjStoreGroup:
         host = socket.gethostname()
         # four slots: enough in-flight chunks to ride out scheduler
         # jitter on oversubscribed hosts; identical constant on every
-        # rank, so writer/reader slot grids always match
+        # member, so writer/reader slot grids always match
         mine = ChunkPipe(pipe_chunk, num_slots=self._PIPE_SLOTS)
-        infos = self._exchange("pipesetup", (host, mine.name))
+        infos = self._exchange("pipesetup", (host, mine.name),
+                               op="setup", phase="pipes")
         if any(h != host for h, _ in infos):
             mine.close()
             self._pipes = None
             return None
-        pred = (self.rank - 1) % self.world_size
+        pred = (self._eff_rank - 1) % self._eff_world
         reader = ChunkPipeReader(infos[pred][1], pipe_chunk,
                                  num_slots=self._PIPE_SLOTS)
         self._pipes = (mine, reader)
@@ -542,22 +975,28 @@ class ObjStoreGroup:
         zero reader-side copies. ``consume(dst, incoming, lo)`` receives
         the chunk's element offset so fused reducers can address the
         matching slice of a sibling buffer."""
+        succ_rank = self._members[(self._eff_rank + 1) % self._eff_world]
+        pred_rank = self._members[(self._eff_rank - 1) % self._eff_world]
         n_send = -(-send.size // chunk_elems) if send.size else 0
         n_recv = -(-recv.size // chunk_elems) if recv.size else 0
         for ci in range(max(n_send, n_recv)):
             lo = ci * chunk_elems
             if ci < n_send:
-                mine.write_chunk(
-                    memoryview(send[lo: lo + chunk_elems]), timeout=120.0)
+                chunk = memoryview(send[lo: lo + chunk_elems])
+                self._guarded_wait(
+                    lambda t, c=chunk: mine.write_chunk(c, timeout=t),
+                    "pipe", "ring_write", ranks=[succ_rank])
             if ci < n_recv:
                 dst = recv[lo: lo + chunk_elems]
-                view = pred.next_chunk(timeout=120.0)
+                view = self._guarded_wait(
+                    lambda t: pred.next_chunk(timeout=t),
+                    "pipe", "ring_read", ranks=[pred_rank])
                 consume(dst, np.frombuffer(view, dtype=recv.dtype), lo)
                 pred.release_chunk()
 
     _INPLACE_REDUCERS = {
         ReduceOp.SUM: np.add,
-        ReduceOp.MEAN: np.add,  # divided by world_size at the end
+        ReduceOp.MEAN: np.add,  # divided by the member count at the end
         ReduceOp.PRODUCT: np.multiply,
         ReduceOp.MAX: np.maximum,
         ReduceOp.MIN: np.minimum,
@@ -565,11 +1004,11 @@ class ObjStoreGroup:
 
     def _pipe_chunk_elems(self, nbytes: int, itemsize: int) -> int:
         """Adaptive ring chunk (policy.chunk_bytes_for): pure function
-        of meta-agreed inputs, so every rank's chunk grid matches."""
+        of meta-agreed inputs, so every member's chunk grid matches."""
         from ray_tpu.util.collective.v2 import policy as policy_mod
 
         chunk_bytes = policy_mod.chunk_bytes_for(
-            nbytes, self.world_size, self._ensure_policy())
+            nbytes, self._eff_world, self._ensure_policy())
         return max(1, chunk_bytes // max(1, itemsize))
 
     def _pipeline_allreduce(self, arr: np.ndarray,
@@ -589,7 +1028,8 @@ class ObjStoreGroup:
         if pipes is None:
             return None
         mine, pred = pipes
-        N = self.world_size
+        N = self._eff_world
+        me = self._eff_rank
         op = ReduceOp(op)
         red = self._INPLACE_REDUCERS[op]
         flat = arr.reshape(-1)
@@ -598,7 +1038,7 @@ class ObjStoreGroup:
             # match the object/channel paths: np.sum/np.prod promote
             # bool/small-int accumulation to 64-bit — an in-place int8
             # ring sum would overflow where np.sum does not. Same
-            # promotion on every rank (dtype is meta-agreed), so the
+            # promotion on every member (dtype is meta-agreed), so the
             # wire dtype stays consistent.
             flat = flat.astype(
                 np.uint64 if flat.dtype.kind == "u" else np.int64)
@@ -612,8 +1052,8 @@ class ObjStoreGroup:
         # reduce-scatter: after N-1 steps rank r owns the fully-reduced
         # segment (r+1) % N
         for s in range(N - 1):
-            send_idx = (self.rank - s) % N
-            recv_idx = (self.rank - s - 1) % N
+            send_idx = (me - s) % N
+            recv_idx = (me - s - 1) % N
             local = seg(flat, recv_idx)
 
             def fused(dst, incoming, lo, _local=local):
@@ -628,8 +1068,8 @@ class ObjStoreGroup:
         # allgather of the reduced segments
         for s in range(N - 1):
             self._ring_step(mine, pred,
-                            seg(acc, (self.rank + 1 - s) % N),
-                            seg(acc, (self.rank - s) % N),
+                            seg(acc, (me + 1 - s) % N),
+                            seg(acc, (me - s) % N),
                             lambda dst, incoming, _lo: np.copyto(dst, incoming),
                             chunk_elems)
         if op == ReduceOp.MEAN:
@@ -637,20 +1077,21 @@ class ObjStoreGroup:
         return acc.reshape(arr.shape)
 
     def _pipeline_allgather(self, arr: np.ndarray) -> Optional[List[np.ndarray]]:
-        """Chunked ring allgather: each rank's tensor circles the ring
-        once, forwarded chunk by chunk."""
+        """Chunked ring allgather: each member's tensor circles the
+        ring once, forwarded chunk by chunk."""
         pipes = self._ensure_pipes()
         if pipes is None:
             return None
         mine, pred = pipes
-        N = self.world_size
+        N = self._eff_world
+        me = self._eff_rank
         flat = arr.reshape(-1)
         chunk_elems = self._pipe_chunk_elems(arr.nbytes, flat.itemsize)
         parts: List[Any] = [None] * N
-        parts[self.rank] = flat.copy()  # own part stays an independent copy
+        parts[me] = flat.copy()  # own part stays an independent copy
         for s in range(N - 1):
-            send_idx = (self.rank - s) % N
-            recv_idx = (self.rank - s - 1) % N
+            send_idx = (me - s) % N
+            recv_idx = (me - s - 1) % N
             parts[recv_idx] = np.empty_like(flat)
             self._ring_step(mine, pred, parts[send_idx], parts[recv_idx],
                             lambda dst, incoming, _lo: np.copyto(dst, incoming),
@@ -658,8 +1099,9 @@ class ObjStoreGroup:
         return [p.reshape(arr.shape) for p in parts]
 
     def allreduce(self, tensor: Any, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
+        self._begin_op()
         arr = np.ascontiguousarray(tensor)
-        with obs_col.op_span("allreduce", arr.nbytes, self.world_size,
+        with obs_col.op_span("allreduce", arr.nbytes, self._eff_world,
                              self.rank) as rec:
             route = self._op_route(arr)
             if route == "hier":
@@ -675,12 +1117,17 @@ class ObjStoreGroup:
                     rec["algo"] = "channel"
                     return _NUMPY_REDUCERS[ReduceOp(op)](np.stack(parts))
             rec["algo"] = "object"
-            parts = self._exchange("allreduce", arr)
+            parts = self._exchange("allreduce", arr, op="allreduce",
+                                   phase="object")
             return _NUMPY_REDUCERS[ReduceOp(op)](np.stack(parts))
 
     def allgather(self, tensor: Any) -> List[np.ndarray]:
+        """Gather every member's tensor, in member order. At a degraded
+        epoch the list is over the SURVIVORS (length = effective
+        world), matching the reduction semantics."""
+        self._begin_op()
         arr = np.ascontiguousarray(tensor)
-        with obs_col.op_span("allgather", arr.nbytes, self.world_size,
+        with obs_col.op_span("allgather", arr.nbytes, self._eff_world,
                              self.rank) as rec:
             route = self._op_route(arr, "allgather")
             if route == "hier":
@@ -696,45 +1143,58 @@ class ObjStoreGroup:
                     rec["algo"] = "channel"
                     return parts
             rec["algo"] = "object"
-            return self._exchange("allgather", arr)
+            return self._exchange("allgather", arr, op="allgather",
+                                  phase="object")
 
     def reducescatter(self, tensor: Any, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
-        """True reduce-scatter: each rank leaves with ONLY its shard of
-        the reduction (np.array_split axis-0 semantics — values are
-        identical to the historical allreduce-then-slice, without
-        materializing or fanning back the full tensor)."""
+        """True reduce-scatter: each member leaves with ONLY its shard
+        of the reduction (np.array_split axis-0 semantics over the
+        CURRENT members — values are identical to the historical
+        allreduce-then-slice, without materializing or fanning back the
+        full tensor)."""
         from ray_tpu.util.collective.v2.executor import shard_bounds
 
+        self._begin_op()
         arr = np.ascontiguousarray(tensor)
-        with obs_col.op_span("reducescatter", arr.nbytes, self.world_size,
+        with obs_col.op_span("reducescatter", arr.nbytes, self._eff_world,
                              self.rank) as rec:
             route = self._op_route(arr, "reducescatter")
             if route == "hier" and arr.ndim >= 1:
                 # ndim is shape-agreed, so the branch is identical on
-                # every rank; 0-d tensors raise in both paths
+                # every member; 0-d tensors raise in both paths
                 return self._executor().reducescatter(arr, ReduceOp(op), rec)
             rec["algo"] = "object"
-            parts = self._exchange("reducescatter", arr)
-            offs, shapes = shard_bounds(arr.shape, self.world_size)
-            lo, hi = offs[self.rank], offs[self.rank + 1]
+            parts = self._exchange("reducescatter", arr,
+                                   op="reducescatter", phase="object")
+            offs, shapes = shard_bounds(arr.shape, self._eff_world)
+            lo, hi = offs[self._eff_rank], offs[self._eff_rank + 1]
             segs = [np.asarray(p).reshape(-1)[lo:hi] for p in parts]
             red = _NUMPY_REDUCERS[ReduceOp(op)](np.stack(segs))
-            return red.reshape(shapes[self.rank])
+            return red.reshape(shapes[self._eff_rank])
 
     def broadcast(self, tensor: Any, src_rank: int = 0) -> np.ndarray:
+        self._begin_op()
+        if self.world_size > 1 and src_rank not in self._members:
+            raise CollectiveRankFailure(
+                (src_rank,), self._epoch, self.group_name,
+                op="broadcast", phase="membership")
         arr = np.ascontiguousarray(tensor)
-        with obs_col.op_span("broadcast", arr.nbytes, self.world_size,
+        with obs_col.op_span("broadcast", arr.nbytes, self._eff_world,
                              self.rank) as rec:
             route = self._op_route(arr, "broadcast")
             if route == "hier":
-                return self._executor().broadcast(arr, src_rank, rec)
+                return self._executor().broadcast(
+                    arr, self._members.index(src_rank), rec)
             rec["algo"] = "object"
-            parts = self._exchange("broadcast", arr)
-            return np.asarray(parts[src_rank])
+            parts = self._exchange("broadcast", arr, op="broadcast",
+                                   phase="object")
+            return np.asarray(parts[self._members.index(src_rank)]) \
+                if self.world_size > 1 else np.asarray(parts[src_rank])
 
     def barrier(self) -> None:
-        with obs_col.op_span("barrier", 0, self.world_size, self.rank):
-            self._exchange("barrier", np.zeros(()))
+        self._begin_op()
+        with obs_col.op_span("barrier", 0, self._eff_world, self.rank):
+            self._exchange("barrier", np.zeros(()), op="barrier")
 
     # -- p2p: per-pair sequence counters, single-rank collect -----------
     def send(self, tensor: Any, dst_rank: int) -> None:
@@ -756,13 +1216,15 @@ class ObjStoreGroup:
             return None if ref is None else [ref]
 
         return self._poll_collect(
-            f"recv from {src_rank} (seq={seq})", once)[0]
+            f"recv from {src_rank} (seq={seq})", once,
+            op="recv", phase="p2p", ranks=[src_rank])[0]
 
     # ------------------------------------------------------------------
     def close(self) -> None:
         """Release every shm endpoint this group holds (channels, meta
         channels, ring pipes, arenas). Called by
-        destroy_collective_group; safe to call more than once."""
+        destroy_collective_group AND by epoch adoption (the survivors
+        rebuild fresh planes); safe to call more than once."""
         for st in list(self._channels.values()):
             if st:
                 st[0].close()

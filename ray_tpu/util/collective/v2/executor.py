@@ -24,6 +24,14 @@ half the intra-host traffic of allreduce and no fan-back), allgather is
 fans out (with a leader hop across hosts). On a single host the ``xh``
 phase vanishes and every op is exactly the shm phases.
 
+Overlap (PR 17): for large segments the allreduce ``reduce_local`` +
+``xh`` pair runs CHUNKED — the segment is cut into policy-agreed blocks
+and block k's cross-host wire time hides behind block k+1's intra-host
+reduction (publish one block, reduce the next, collect in order). The
+wire format per block is the ordinary ``xh`` wire; only the key gains a
+block suffix, so the barriered and overlapped paths reduce to the same
+bytes in the same order (bit-identical for the exact codec).
+
 Exactness: with the exact codec the reduction accumulates sequentially
 in ascending rank order with the same dtype promotion rules as
 ``np.sum``/``np.mean`` over a stacked axis — on a SINGLE host this is
@@ -33,10 +41,19 @@ Across hosts the per-host partials reassociate the float sum
 identical on every rank, integer reductions stay bit-identical, floats
 differ from the flat order only in the last ulp. With the int8 codec
 the op obeys the error contract in :mod:`.quant`.
+
+Elasticity: the executor addresses the group through its EFFECTIVE
+coordinates (``_eff_rank``/``_eff_world`` — dense indices into the
+current epoch's member tuple); the topology is built over the members,
+so counterpart/leader math transparently spans degraded epochs. Every
+arena wait goes through the group's ``_guarded_wait`` so a local peer
+dying mid-phase raises a typed failure within the detection window
+instead of a 120 s hang.
 """
 
 from __future__ import annotations
 
+import os
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -53,6 +70,17 @@ _ACC_UFUNC = {
     ReduceOp.MAX: np.maximum,
     ReduceOp.MIN: np.minimum,
 }
+
+
+def _chaos(op: str, phase: str) -> None:
+    """Deterministic fault injection for the chaos tests: when
+    ``RAY_TPU_COLLECTIVE_CHAOS_DIE`` names this phase (``"<phase>"`` or
+    ``"<op>:<phase>"``), die the way a preempted worker dies — no
+    cleanup, no exception, the process is simply gone. The tests stage
+    the env var on exactly one rank."""
+    want = os.environ.get("RAY_TPU_COLLECTIVE_CHAOS_DIE", "")
+    if want and (want == phase or want == f"{op}:{phase}"):
+        os._exit(1)
 
 
 def acc_dtype(dtype, op: ReduceOp):
@@ -107,16 +135,47 @@ def shard_bounds(shape: Tuple[int, ...], parts: int):
 class HierarchicalExecutor:
     """Stateless algorithm layer over one ObjStoreGroup's transports.
 
-    The group provides: ``rank``/``world_size``, ``_topology``
-    (:class:`Topology`), ``_policy2`` (:class:`GroupPolicy`),
+    The group provides: ``_eff_rank``/``_eff_world`` (dense coordinates
+    in the current epoch), ``_topology`` (:class:`Topology`, built over
+    the members), ``_policy2`` (:class:`GroupPolicy`),
     ``_ensure_arena(nbytes)`` (host-local :class:`ShmArena`, slots and
-    region each >= nbytes), ``_sub_exchange(key, value, ranks)``
-    (object-path all-to-all among a rank subset) and
-    ``_scatter_exchange(key, per_dest, ranks)`` (pairwise: each
-    participant receives only what was addressed to it)."""
+    region each >= nbytes), ``_sub_exchange(key, value, eff_ranks)`` /
+    ``_sub_put``+``_sub_collect`` (object-path all-to-all among an
+    effective-rank subset, sync or split for overlap),
+    ``_scatter_exchange(key, per_dest, eff_ranks)`` (pairwise: each
+    participant receives only what was addressed to it) and
+    ``_guarded_wait(fn, op, phase, ranks)`` (deadline-budgeted,
+    liveness-probing shm waits)."""
 
     def __init__(self, group):
         self._g = group
+
+    # ------------------------------------------------------------------
+    def _local_peer_globals(self) -> List[int]:
+        """GLOBAL ranks of my host's other members — the suspect list
+        for intra-host (arena) waits."""
+        g = self._g
+        topo = g._topology
+        return [g._members[p] for p in topo.local_peers
+                if p != g._eff_rank]
+
+    def _begin(self, arena, op: str) -> None:
+        g = self._g
+        peers = self._local_peer_globals()
+        g._guarded_wait(lambda t: arena.begin(timeout=t),
+                        op, "arena_begin", ranks=peers)
+
+    def _wait_wrote(self, arena, op: str, only: Optional[int] = None) -> None:
+        g = self._g
+        peers = self._local_peer_globals()
+        g._guarded_wait(lambda t: arena.wait_wrote(timeout=t, only=only),
+                        op, "encode", ranks=peers)
+
+    def _wait_posted(self, arena, op: str) -> None:
+        g = self._g
+        peers = self._local_peer_globals()
+        g._guarded_wait(lambda t: arena.wait_posted(timeout=t),
+                        op, "publish", ranks=peers)
 
     # ------------------------------------------------------------------
     def _codecs(self, flat: np.ndarray, op: Optional[ReduceOp]):
@@ -185,29 +244,55 @@ class HierarchicalExecutor:
         codec.encode_into(seg, memoryview(buf))
         return buf
 
+    @staticmethod
+    def _xh_accumulate(codec, wires_or_vals, nelems: int, op: ReduceOp,
+                       adt) -> np.ndarray:
+        """Reduce one cross-host exchange's payloads in sender (host)
+        order — shared by the barriered and overlapped paths so both
+        produce the same bytes."""
+        if isinstance(codec, Int8BlockCodec):
+            acc = codec.decode_slice(
+                memoryview(wires_or_vals[0]), nelems, 0, nelems)
+            for w in wires_or_vals[1:]:
+                codec.decode_slice(memoryview(w), nelems, 0, nelems,
+                                   out=acc, add=True)
+            return acc
+        ufunc = _ACC_UFUNC[op]
+        acc = np.asarray(wires_or_vals[0]).astype(adt, copy=True)
+        for v in wires_or_vals[1:]:
+            ufunc(acc, np.asarray(v), out=acc)
+        return acc
+
     def _xh_reduce(self, rec, opname: str, codec, seg: np.ndarray,
                    tag: str, op: ReduceOp, adt) -> np.ndarray:
-        """Cross-host phase: allreduce ``seg`` within my counterpart
-        group (same local index on every host) over the object path."""
+        """Cross-host phase (barriered): allreduce ``seg`` within my
+        counterpart group (same local index on every host) over the
+        object path."""
         g = self._g
         topo = g._topology
         peers = topo.counterparts()
         with obs_col.phase_span(rec, opname, "xh", seg.nbytes):
-            if isinstance(codec, Int8BlockCodec):
-                wires = g._sub_exchange(
-                    f"xh_{tag}", self._wire_of(codec, seg), list(peers))
-                acc = codec.decode_slice(
-                    memoryview(wires[0]), seg.size, 0, seg.size)
-                for w in wires[1:]:
-                    codec.decode_slice(memoryview(w), seg.size, 0,
-                                       seg.size, out=acc, add=True)
-                return acc
-            vals = g._sub_exchange(f"xh_{tag}", seg, list(peers))
-            ufunc = _ACC_UFUNC[op]
-            acc = np.asarray(vals[0]).astype(adt, copy=True)
-            for v in vals[1:]:
-                ufunc(acc, np.asarray(v), out=acc)
-            return acc
+            payload = self._wire_of(codec, seg) \
+                if isinstance(codec, Int8BlockCodec) else seg
+            vals = g._sub_exchange(f"xh_{tag}", payload, list(peers),
+                                   op=opname, phase="xh")
+            return self._xh_accumulate(codec, vals, seg.size, op, adt)
+
+    def _xh_blocks(self, rec, opname: str, codec, nblk: int,
+                   seg_nbytes: int) -> Optional[List[int]]:
+        """Block grid for the overlapped reduce_local+xh pipeline, or
+        None when the op stays barriered. Pure function of group-agreed
+        inputs (policy knobs, segment size — identical across the
+        counterpart group under a uniform topology), so every
+        participant chunks identically."""
+        g = self._g
+        pol = g._policy2
+        if not pol.overlap or seg_nbytes < pol.overlap_min_bytes \
+                or seg_nbytes <= pol.overlap_block_bytes or nblk < 1:
+            return None
+        blocks = max(2, -(-seg_nbytes // pol.overlap_block_bytes))
+        rec["overlap_blocks"] = blocks
+        return seg_bounds(nblk, blocks, align=codec.block)
 
     # ------------------------------------------------------------------
     def allreduce(self, arr: np.ndarray, op: ReduceOp,
@@ -230,7 +315,8 @@ class HierarchicalExecutor:
         arena = self._arena_for(slot_codec.wire_nbytes(n), roffs[-1])
         lr = topo.local_rank
         lo, hi = bounds[lr], bounds[lr + 1]
-        arena.begin()
+        self._begin(arena, "allreduce")
+        _chaos("allreduce", "encode")
         with obs_col.phase_span(rec, "allreduce", "encode", flat.nbytes):
             # own segment skips the shm round trip: this rank reduces it
             # straight from its local array, and no peer ever reads it
@@ -238,22 +324,35 @@ class HierarchicalExecutor:
             slot_codec.encode_into(flat, mv, 0, lo)
             slot_codec.encode_into(flat, mv, hi, n)
             arena.mark_wrote()
-            arena.wait_wrote()
-        with obs_col.phase_span(rec, "allreduce", "reduce_local",
-                                (hi - lo) * flat.itemsize * L):
-            slots = [arena.slot(r) for r in range(L)]
-            acc = self._reduce_slices(slot_codec, slots, n, lo, hi, op, adt,
-                                      own=lr, own_data=flat) \
-                if hi > lo else np.empty(0, adt)
+            self._wait_wrote(arena, "allreduce")
+        _chaos("allreduce", "reduce_local")
+        slots = [arena.slot(r) for r in range(L)]
+        overlapped = False
         if not topo.single_host and hi > lo:
-            acc = self._xh_reduce(rec, "allreduce", seg_codec, acc,
-                                  f"ar{lr}", op, adt)
+            blk = self._xh_blocks(rec, "allreduce", seg_codec, hi - lo,
+                                  (hi - lo) * flat.itemsize)
+            if blk is not None:
+                acc = self._overlapped_reduce_xh(
+                    rec, slot_codec, seg_codec, slots, flat, n, lo, hi,
+                    blk, lr, op, adt)
+                overlapped = True
+        if not overlapped:
+            with obs_col.phase_span(rec, "allreduce", "reduce_local",
+                                    (hi - lo) * flat.itemsize * L):
+                acc = self._reduce_slices(slot_codec, slots, n, lo, hi,
+                                          op, adt, own=lr, own_data=flat) \
+                    if hi > lo else np.empty(0, adt)
+            if not topo.single_host and hi > lo:
+                _chaos("allreduce", "xh")
+                acc = self._xh_reduce(rec, "allreduce", seg_codec, acc,
+                                      f"ar{lr}", op, adt)
         with obs_col.phase_span(rec, "allreduce", "publish", acc.nbytes):
             if hi > lo:
                 seg_codec.encode_into(
                     acc, arena.region()[roffs[lr]: roffs[lr + 1]])
             arena.mark_posted()
-            arena.wait_posted()
+            self._wait_posted(arena, "allreduce")
+        _chaos("allreduce", "gather")
         with obs_col.phase_span(rec, "allreduce", "gather", flat.nbytes):
             out = np.empty(n, out_dt)
             region = arena.region()
@@ -273,10 +372,62 @@ class HierarchicalExecutor:
                 out[slo:shi] = dec  # casts quant f32 -> out dtype
             arena.mark_done()
         if op == ReduceOp.MEAN and isinstance(slot_codec, Int8BlockCodec):
-            out = (out.astype(np.float32) / g.world_size).astype(out_dt)
+            out = (out.astype(np.float32) / g._eff_world).astype(out_dt)
         elif op == ReduceOp.MEAN:
-            out = out / g.world_size  # true divide: matches np.mean
+            out = out / g._eff_world  # true divide: matches np.mean
         return out.reshape(arr.shape)
+
+    def _overlapped_reduce_xh(self, rec, slot_codec, seg_codec, slots,
+                              flat, n, lo, hi, blk, lr, op: ReduceOp,
+                              adt) -> np.ndarray:
+        """Chunked reduce_local + xh pipeline: reduce block k locally,
+        PUBLISH its wire (non-blocking put), move on to block k+1 —
+        block k's cross-host transfer rides under k+1's reduction.
+        Collection then accumulates in block order and host order, so
+        the result is byte-identical to the barriered path (exact
+        codec) / within the same quant bound (int8).
+
+        The per-block wire is the ordinary xh wire over the block's
+        elements; the key carries the block index, so counterpart
+        groups (which chunk identically — the grid is a pure function
+        of group-agreed inputs) rendezvous block by block."""
+        g = self._g
+        topo = g._topology
+        peers = list(topo.counterparts())
+        tag = f"ar{lr}"
+        handles = []
+        parts: List[np.ndarray] = []
+        nblk = len(blk) - 1
+        with obs_col.phase_span(rec, "allreduce", "reduce_local",
+                                (hi - lo) * flat.itemsize * len(slots)):
+            for k in range(nblk):
+                blo, bhi = lo + blk[k], lo + blk[k + 1]
+                if bhi <= blo:
+                    parts.append(np.empty(0, adt))
+                    handles.append(None)
+                    continue
+                part = self._reduce_slices(slot_codec, slots, n, blo, bhi,
+                                           op, adt, own=lr, own_data=flat)
+                parts.append(part)
+                _chaos("allreduce", f"xh_chunk{k}")
+                with obs_col.phase_span(rec, "allreduce", "xh", 0):
+                    handles.append(g._sub_put(
+                        f"xh_{tag}_b{k}",
+                        self._wire_of(seg_codec, part)
+                        if isinstance(seg_codec, Int8BlockCodec) else part,
+                        peers, op="allreduce", phase="xh"))
+        acc = np.empty(hi - lo, np.float32
+                       if isinstance(seg_codec, Int8BlockCodec) else adt)
+        with obs_col.phase_span(rec, "allreduce", "xh",
+                                (hi - lo) * flat.itemsize):
+            for k in range(nblk):
+                if handles[k] is None:
+                    continue
+                vals = g._sub_collect(handles[k])
+                blo, bhi = blk[k], blk[k + 1]
+                acc[blo:bhi] = self._xh_accumulate(
+                    seg_codec, vals, bhi - blo, op, adt)
+        return acc
 
     # ------------------------------------------------------------------
     def reducescatter(self, arr: np.ndarray, op: ReduceOp,
@@ -290,20 +441,22 @@ class HierarchicalExecutor:
         rec = rec if rec is not None else {}
         flat = np.ascontiguousarray(arr).reshape(-1)
         n = flat.size
-        offs, shapes = shard_bounds(arr.shape, g.world_size)
+        me = g._eff_rank
+        offs, shapes = shard_bounds(arr.shape, g._eff_world)
         codec = ExactCodec(flat.dtype)  # intra-host RS stays exact
         adt = acc_dtype(flat.dtype, op)
         rec["algo"], rec["codec"] = "hier", codec.name
         rec["topology"] = topo.describe()
         arena = self._arena_for(codec.wire_nbytes(n), 0)
         lr = topo.local_rank
-        arena.begin()
+        self._begin(arena, "reducescatter")
+        _chaos("reducescatter", "encode")
         with obs_col.phase_span(rec, "reducescatter", "encode", flat.nbytes):
             # shards only THIS rank reduces (its counterpart set) skip
             # the shm round trip — their contribution comes from the
             # local array; everything other local ranks read is written
             mv = arena.slot(lr)
-            mine_only = [g.rank] if topo.single_host \
+            mine_only = [me] if topo.single_host \
                 else list(topo.counterparts())
             prev = 0
             for p in sorted(mine_only):
@@ -311,7 +464,8 @@ class HierarchicalExecutor:
                 prev = offs[p + 1]
             codec.encode_into(flat, mv, prev, n)
             arena.mark_wrote()
-            arena.wait_wrote()
+            self._wait_wrote(arena, "reducescatter")
+        _chaos("reducescatter", "reduce_local")
         slots = [arena.slot(r) for r in range(topo.local_world)]
 
         def partial(rank: int) -> np.ndarray:
@@ -324,31 +478,32 @@ class HierarchicalExecutor:
         if topo.single_host:
             with obs_col.phase_span(
                     rec, "reducescatter", "reduce_local",
-                    (offs[g.rank + 1] - offs[g.rank]) * flat.itemsize
+                    (offs[me + 1] - offs[me]) * flat.itemsize
                     * topo.local_world):
-                acc = partial(g.rank)
+                acc = partial(me)
         else:
             peers = topo.counterparts()
             with obs_col.phase_span(rec, "reducescatter", "reduce_local",
                                     flat.nbytes):
                 mine = {p: partial(p) for p in peers}
+            _chaos("reducescatter", "xh")
             with obs_col.phase_span(
                     rec, "reducescatter", "xh",
-                    (offs[g.rank + 1] - offs[g.rank]) * flat.itemsize):
+                    (offs[me + 1] - offs[me]) * flat.itemsize):
                 # pairwise scatter: each peer receives ONLY its shard
                 vals = g._scatter_exchange(
                     f"xh_rs{topo.local_rank}",
-                    {p: mine[p] for p in peers if p != g.rank},
-                    list(peers))
-                acc = mine[g.rank]
+                    {p: mine[p] for p in peers if p != me},
+                    list(peers), op="reducescatter", phase="xh")
+                acc = mine[me]
                 ufunc = _ACC_UFUNC[op]
                 for d in vals:
                     ufunc(acc, np.asarray(d), out=acc)
         arena.mark_posted()
         arena.mark_done()
         if op == ReduceOp.MEAN:
-            acc = acc / g.world_size
-        return acc.reshape(shapes[g.rank])
+            acc = acc / g._eff_world
+        return acc.reshape(shapes[me])
 
     # ------------------------------------------------------------------
     def allgather(self, arr: np.ndarray,
@@ -365,17 +520,19 @@ class HierarchicalExecutor:
         rec["algo"], rec["codec"] = "hier", codec.name
         rec["topology"] = topo.describe()
         arena = self._arena_for(codec.wire_nbytes(n), 0)
-        arena.begin()
+        self._begin(arena, "allgather")
+        _chaos("allgather", "encode")
         with obs_col.phase_span(rec, "allgather", "encode", flat.nbytes):
             codec.encode_into(flat, arena.slot(topo.local_rank))
             arena.mark_wrote()
-            arena.wait_wrote()
+            self._wait_wrote(arena, "allgather")
+        _chaos("allgather", "gather")
         with obs_col.phase_span(rec, "allgather", "gather",
                                 flat.nbytes * topo.local_world):
-            parts: List[np.ndarray] = [None] * g.world_size  # type: ignore
+            parts: List[np.ndarray] = [None] * g._eff_world  # type: ignore
             for r in range(topo.local_world):
                 rank = topo.local_peers[r]
-                if rank == g.rank:
+                if rank == g._eff_rank:
                     parts[rank] = flat.copy().reshape(arr.shape)
                 else:
                     parts[rank] = codec.decode_slice(
@@ -388,27 +545,32 @@ class HierarchicalExecutor:
     # ------------------------------------------------------------------
     def broadcast(self, arr: np.ndarray, src_rank: int,
                   rec: Optional[dict] = None) -> np.ndarray:
+        """``src_rank`` is an EFFECTIVE index (the caller maps the
+        global source through the member tuple)."""
         g = self._g
         topo = g._topology
         rec = rec if rec is not None else {}
         flat = np.ascontiguousarray(arr).reshape(-1)
         n = flat.size
+        me = g._eff_rank
         codec = ExactCodec(flat.dtype)
         rec["algo"], rec["codec"] = "hier", codec.name
         rec["topology"] = topo.describe()
-        data = flat if g.rank == src_rank else None
+        data = flat if me == src_rank else None
         if not topo.single_host:
             src_host = topo.keys[src_rank]
             ranks = sorted({src_rank} | {
                 topo.leader(h) for h in topo.hosts if h != src_host})
-            if g.rank in ranks:
+            if me in ranks:
+                _chaos("broadcast", "xh")
                 with obs_col.phase_span(rec, "broadcast", "xh", flat.nbytes):
                     # src_rank is part of the key: each key's participant
                     # set must be FIXED, or broadcasts from different
                     # sources would desync the per-key sequence counters
                     vals = g._sub_exchange(
                         f"xh_bcast{src_rank}",
-                        data if g.rank == src_rank else None, ranks)
+                        data if me == src_rank else None, ranks,
+                        op="broadcast", phase="xh")
                     data = np.asarray(vals[ranks.index(src_rank)]).reshape(-1)
             local_src = src_rank if topo.my_host == src_host \
                 else topo.leader(topo.my_host)
@@ -416,12 +578,14 @@ class HierarchicalExecutor:
             local_src = src_rank
         lsrc = topo.local_peers.index(local_src)
         arena = self._arena_for(codec.wire_nbytes(n), 0)
-        arena.begin()
+        self._begin(arena, "broadcast")
+        _chaos("broadcast", "encode")
         with obs_col.phase_span(rec, "broadcast", "encode", flat.nbytes):
             if topo.local_rank == lsrc:
                 codec.encode_into(data, arena.slot(lsrc))
             arena.mark_wrote()
-            arena.wait_wrote(only=lsrc)
+            self._wait_wrote(arena, "broadcast", only=lsrc)
+        _chaos("broadcast", "gather")
         with obs_col.phase_span(rec, "broadcast", "gather", flat.nbytes):
             if topo.local_rank == lsrc:
                 out = data.copy()

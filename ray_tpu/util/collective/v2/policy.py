@@ -59,6 +59,13 @@ def _env_int(name: str, default: int) -> int:
         return default
 
 
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
 @dataclasses.dataclass(frozen=True)
 class GroupPolicy:
     """The group-agreed knob set (one instance per ObjStoreGroup)."""
@@ -72,6 +79,13 @@ class GroupPolicy:
     quant_block: int
     small_max_bytes: int
     hier_min_bytes: int
+    # fault model + overlap knobs (PR 17). Defaults keep old
+    # positionally-constructed policies valid.
+    op_timeout_s: float = 120.0     # group deadline for any op leg
+    wan_gbps: float = 0.0           # >0: simulated cross-host bandwidth cap
+    overlap: bool = True            # chunked async xh overlap
+    overlap_block_bytes: int = 256 << 10
+    overlap_min_bytes: int = 256 << 10
 
 
 def local_knobs() -> Tuple:
@@ -95,6 +109,12 @@ def local_knobs() -> Tuple:
                          quant_mod.DEFAULT_BLOCK)),
         _env_int("RAY_TPU_COLLECTIVE_SMALL_MAX_BYTES", 64 << 10),
         _env_int("RAY_TPU_COLLECTIVE_HIER_MIN_BYTES", 256 << 10),
+        max(0.1, _env_float("RAY_TPU_COLLECTIVE_OP_TIMEOUT_S", 120.0)),
+        max(0.0, _env_float("RAY_TPU_COLLECTIVE_WAN_GBPS", 0.0)),
+        os.environ.get("RAY_TPU_COLLECTIVE_OVERLAP", "1") != "0",
+        max(4096, _env_int("RAY_TPU_COLLECTIVE_OVERLAP_BLOCK_BYTES",
+                           256 << 10)),
+        _env_int("RAY_TPU_COLLECTIVE_OVERLAP_MIN_BYTES", 256 << 10),
     )
 
 
@@ -123,6 +143,16 @@ def merge_knobs(infos) -> GroupPolicy:
         # off the newer hier plane unless every rank lowers the knob
         small_max_bytes=max(i[7] for i in infos),
         hier_min_bytes=max(i[8] for i in infos),
+        # a rank wanting to fail faster wins (min); WAN sim only runs
+        # when every rank simulates it (the slowest simulated link
+        # caps the group); overlap needs unanimity, and the largest
+        # block/threshold chunks the least (conservative direction)
+        op_timeout_s=min(i[9] for i in infos),
+        wan_gbps=min(i[10] for i in infos)
+        if all(i[10] > 0 for i in infos) else 0.0,
+        overlap=all(i[11] for i in infos),
+        overlap_block_bytes=max(i[12] for i in infos),
+        overlap_min_bytes=max(i[13] for i in infos),
     )
 
 

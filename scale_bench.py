@@ -274,6 +274,165 @@ def bench_collective(n_ops: int) -> dict:
     }
 
 
+def _bench_collective_preempt(n_ops: int) -> dict:
+    """Elastic-collective leg (PR 17): 4 ranks pinned two-per-worker
+    on a 3-node cluster run a sustained hierarchical allreduce while a
+    seeded drain takes one worker node. Records the recovery time
+    (drain start -> first EXACT degraded sum on the survivors) and the
+    sustained GB/s before and after the resize — the claim the smoke
+    variant in tier-1 enforces is zero hangs and zero silent wrong
+    results, not a throughput bar."""
+    import threading
+    import types
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private.chaos import PreemptionInjector
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util import state as rstate
+    from ray_tpu.util.collective.types import (
+        CollectiveError,
+        CollectiveRankFailure,
+    )
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    nbytes = 4 * (1 << 20)
+    n = nbytes // 4
+
+    @ray_tpu.remote(num_cpus=0, max_restarts=0)
+    class Member:
+        def __init__(self, rank, world, env):
+            import os
+
+            for k, val in env.items():
+                os.environ[k] = val
+            from ray_tpu.util import collective as col
+
+            self.rank = rank
+            col.init_collective_group(world, rank, backend="objstore",
+                                      group_name="sb_colp")
+            self.arr = np.full(n, float(rank + 1), np.float32)
+
+        def one(self):
+            """One allreduce; (uniform?, value) — enough to verify the
+            sum is exactly a pinned member set's sum."""
+            from ray_tpu.util import collective as col
+
+            out = col.allreduce(self.arr, group_name="sb_colp")
+            return bool(np.all(out == out[0])), float(out[0])
+
+        def stream(self, iters):
+            import time as _t
+
+            from ray_tpu.util import collective as col
+
+            t0 = _t.perf_counter()
+            for _ in range(iters):
+                col.allreduce(self.arr, group_name="sb_colp")
+            return _t.perf_counter() - t0
+
+        def destroy(self):
+            from ray_tpu.util import collective as col
+
+            col.destroy_collective_group("sb_colp")
+            return True
+
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2)  # head: driver (+ maybe rendezvous)
+    workers = [cluster.add_node(num_cpus=2), cluster.add_node(num_cpus=2)]
+    cluster.wait_for_nodes()
+    try:
+        ray_tpu.init(address=cluster.address)
+        node_of = [workers[0], workers[0], workers[1], workers[1]]
+        keys = ["nodeA", "nodeA", "nodeB", "nodeB"]
+        ws = []
+        for r in range(4):
+            env = {"RAY_TPU_COLLECTIVE_TOPOLOGY_KEY": keys[r],
+                   "RAY_TPU_COLLECTIVE_OP_TIMEOUT_S": "15"}
+            ws.append(Member.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_of[r].node_id, soft=False)
+            ).remote(r, 4, env))
+        ray_tpu.get([w.stream.remote(2) for w in ws], timeout=300)  # warm
+        times = ray_tpu.get([w.stream.remote(n_ops) for w in ws],
+                            timeout=1800)
+        pre_gb_s = nbytes * n_ops / max(times) / 1e9
+
+        # victim = the worker node NOT hosting the rendezvous actor
+        rdv = ray_tpu.get_actor("__collective_rdv_sb_colp")
+        rdv_node = (rstate.get_actor(rdv._actor_id.hex()) or
+                    {}).get("node_id")
+        victim = workers[0] if workers[1].node_id == rdv_node \
+            else workers[1]
+        victim_ranks = [r for r in range(4) if node_of[r] is victim]
+        surv_ranks = [r for r in range(4) if r not in victim_ranks]
+        surv_sum = float(sum(r + 1 for r in surv_ranks))
+        plausible = {10.0, surv_sum} | {
+            surv_sum + (v + 1) for v in victim_ranks}
+
+        injector = PreemptionInjector(
+            types.SimpleNamespace(nodes=[victim],
+                                  gcs_port=cluster.gcs_port),
+            max_preemptions=1, seed=7, deadline_s=3.0, jitter_s=0.0,
+            kill_grace_s=2.0)
+        killer = threading.Thread(target=injector.preempt_one,
+                                  daemon=True)
+        t0 = time.perf_counter()
+        killer.start()
+
+        live = {r: ws[r] for r in range(4)}
+        wrong = 0
+        recovery_s = None
+        hard_stop = time.monotonic() + 180
+        while recovery_s is None and time.monotonic() < hard_stop:
+            futs = {r: live[r].one.remote() for r in sorted(live)}
+            ok = {}
+            for r, f in futs.items():
+                try:
+                    uniform, val = ray_tpu.get(f, timeout=60)
+                    if not uniform or val not in plausible:
+                        wrong += 1
+                    else:
+                        ok[r] = val
+                except Exception as e:  # noqa: BLE001
+                    if isinstance(e, CollectiveRankFailure) and \
+                            r in e.dead_ranks:
+                        live.pop(r, None)  # drained-rank hand-off
+                    elif not isinstance(e, CollectiveError):
+                        live.pop(r, None)  # actor/node death
+            if injector.preempted and sorted(ok) == surv_ranks and \
+                    all(v == surv_sum for v in ok.values()):
+                recovery_s = time.perf_counter() - t0
+        killer.join(timeout=15)
+
+        surv = [ws[r] for r in surv_ranks]
+        times = ray_tpu.get([w.stream.remote(n_ops) for w in surv],
+                            timeout=1800)
+        post_gb_s = nbytes * n_ops / max(times) / 1e9
+        ray_tpu.get([w.destroy.remote() for w in surv], timeout=120)
+        return {
+            "world_size": 4,
+            "payload_mb": 4,
+            "ops": n_ops,
+            "preempted": bool(injector.preempted),
+            "recovery_s": round(recovery_s, 2)
+            if recovery_s is not None else None,
+            "silent_wrong_results": wrong,
+            "pre_sustained_gb_s": round(pre_gb_s, 3),
+            "post_sustained_gb_s": round(post_gb_s, 3),
+            "post_world": len(surv_ranks),
+        }
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        cluster.shutdown()
+
+
 def bench_serve_soak(n_clients: int, duration_s: float = 30.0,
                      workload: str = "llm", *,
                      drain: bool = True,
@@ -755,6 +914,11 @@ def _run_phase(phase: str, n: int, n2: int = 0) -> None:
         out = bench_rl(n)
         print("PHASE_JSON " + json.dumps(out), flush=True)
         return
+    if phase == "collective_preempt":
+        # builds (and tears down) its own 3-node cluster; n = ops/leg
+        out = _bench_collective_preempt(n)
+        print("PHASE_JSON " + json.dumps(out), flush=True)
+        return
     if phase == "serve_soak":
         # builds (and tears down) its own 2-node cluster; n = clients.
         # Admission is sized to SERVING CAPACITY (~3x the engines' KV
@@ -824,6 +988,7 @@ def main() -> None:
                   ("combined", n_tasks, n_actors),
                   ("preempt_1of2_nodes", n_preempt, 0),
                   ("collective", n_col_ops, 0),
+                  ("collective_preempt", n_col_ops, 0),
                   ("serve_soak", n_soak_clients, 0),
                   ("rl", n_rl_frames, 0))
     if args.only:

@@ -91,6 +91,10 @@ def _make_thread_ring(world, chunk=8192):
     for r in range(world):
         g = ObjStoreGroup.__new__(ObjStoreGroup)
         g.world_size, g.rank = world, r
+        # epoch coordinates (PR 17 elasticity): full-strength membership
+        g._epoch = 0
+        g._members = tuple(range(world))
+        g._eff_rank, g._eff_world = r, world
         g._policy2 = v2.GroupPolicy(
             channels_enabled=True, channel_max_bytes=1024,
             pipe_chunk_bytes=chunk, algo="auto", quant_mode="off",

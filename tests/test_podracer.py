@@ -284,6 +284,47 @@ class TestSebulba:
         finally:
             algo.stop()
 
+    # slow: full 2-actor/2-learner fleet, a mid-broadcast learner kill
+    # and a post-rotation training round (~18s); the underlying
+    # fail-fast + rotation machinery is tier-1-covered by
+    # test_collective_elastic's fail-fast and chaos-kill tests
+    @pytest.mark.slow
+    def test_cross_learner_sync_survives_mid_broadcast_kill(
+            self, local_ray):
+        """Regression for the elastic weight-sync path: learner 1 is
+        hard-killed right before a cross-learner broadcast. Rank 0's
+        broadcast must fail fast with a typed membership error (not sit
+        out the full op deadline), the driver must classify BOTH
+        failures as membership events (zero app errors), rotate the
+        fleet onto a fresh group generation, respawn the dead rank from
+        checkpoint, and the next sync must succeed clean."""
+        cfg = SebulbaConfig(num_actors=2, num_learners=2,
+                            rollout_fragment_length=32,
+                            updates_per_train=4,
+                            sync_every_iterations=1,
+                            checkpoint_interval=2, seed=0)
+        algo = cfg.build()
+        try:
+            r = algo.train()  # healthy sync on generation 0
+            assert r["group_rotations"] == 0
+            assert r["app_errors"] == 0
+            algo.kill_learner(1)
+            t0 = time.monotonic()
+            algo._sync_learners()  # broadcast with a dead counterpart
+            elapsed = time.monotonic() - t0
+            assert elapsed < 60, \
+                "mid-broadcast death stalled the driver (no fail-fast)"
+            assert algo.group_rotations == 1
+            assert algo.learner_restarts == 1
+            assert algo.app_errors == 0
+            r = algo.train()  # post-rotation iteration syncs clean
+            assert r["app_errors"] == 0
+            assert r["order_errors"] == 0
+            assert r["group_rotations"] == 1
+            assert r["learner_restarts"] == 1
+        finally:
+            algo.stop()
+
 
 class TestSebulbaPreemption:
     def test_actor_preemption_mid_stream(self):

@@ -76,6 +76,37 @@ class TestRC001:
         assert _details(fs) == [("RC001", "inline:time.sleep")]
         assert "reached via Server._helper" in fs[0].message
 
+    def test_flags_bare_handle_result_in_async_def(self, tmp_path):
+        """A CollectiveHandle.result() without a timeout waits behind
+        the group's whole async op queue — on loop code that is an
+        unbounded park, exactly the shape RC001 exists for."""
+        fs = _scan(tmp_path, "mod.py", """
+            async def on_drain(self, handle):
+                return handle.result()
+        """, rules=["RC001"])
+        assert _details(fs) == [("RC001", "async:handle.result")]
+
+    def test_handle_result_with_timeout_is_clean(self, tmp_path):
+        fs = _scan(tmp_path, "mod.py", """
+            async def on_drain(self, handle):
+                return handle.result(timeout=5.0)
+        """, rules=["RC001"])
+        assert fs == []
+
+    def test_handle_result_reachable_from_inline_handler(self, tmp_path):
+        fs = _scan(tmp_path, "mod.py", """
+            def finish(handle):
+                return handle.result()
+
+            class Server:
+                def __init__(self, srv):
+                    srv.register("Sync", self._sync, inline=True)
+
+                def _sync(self, handle):
+                    return finish(handle)
+        """, rules=["RC001"])
+        assert ("RC001", "inline:handle.result") in _details(fs)
+
     def test_awaited_wait_is_not_blocking(self, tmp_path):
         fs = _scan(tmp_path, "mod.py", """
             import asyncio
@@ -1100,6 +1131,83 @@ class TestRC008:
                 if actor.state == "DEAD":
                     actor.state = "ALIVE"  # raycheck: disable=RC008
         """, rules=["RC008"])
+        assert fs == []
+
+
+class TestRC008Membership:
+    """The elastic-collective membership machine: the resize cycle
+    ACTIVE -> DRAINING_RANK -> RESIZED -> ACTIVE only moves forward.
+    State constants are module-level names, exercising the constant
+    resolution RC008 grew alongside this machine."""
+
+    MEM = "ray_tpu/util/collective/v2/membership.py"
+    # indented to match the test bodies so the concatenation dedents
+    # as one block
+    CONSTS = """
+            ACTIVE = "ACTIVE"
+            DRAINING_RANK = "DRAINING_RANK"
+            RESIZED = "RESIZED"
+    """
+
+    def test_legal_cycle_is_clean(self, tmp_path):
+        fs = _scan(tmp_path, self.MEM, self.CONSTS + """
+            class GroupMembership:
+                def __init__(self):
+                    self.state = ACTIVE
+
+                def flag(self):
+                    if self.state == ACTIVE:
+                        self.state = DRAINING_RANK
+
+                def commit(self):
+                    if self.state != DRAINING_RANK:
+                        return
+                    self.state = RESIZED
+
+                def reactivate(self):
+                    if self.state == RESIZED:
+                        self.state = ACTIVE
+        """, rules=["RC008"])
+        assert fs == []
+
+    def test_resize_shortcut_is_illegal(self, tmp_path):
+        """Skipping the flag pass (ACTIVE -> RESIZED) would bump the
+        epoch without ever recording who left — a silent resize."""
+        fs = _scan(tmp_path, self.MEM, self.CONSTS + """
+            def shortcut(mem):
+                if mem.state == ACTIVE:
+                    mem.state = RESIZED
+        """, rules=["RC008"])
+        assert _details(fs) == [("RC008", "illegal:ACTIVE->RESIZED")]
+
+    def test_backwards_edge_is_illegal(self, tmp_path):
+        """RESIZED -> DRAINING_RANK re-opens a committed resize: the
+        epoch an in-flight op pinned would no longer be immutable."""
+        fs = _scan(tmp_path, self.MEM, self.CONSTS + """
+            def reopen(mem):
+                if mem.state == RESIZED:
+                    mem.state = DRAINING_RANK
+        """, rules=["RC008"])
+        assert _details(fs) == [
+            ("RC008", "illegal:RESIZED->DRAINING_RANK")]
+
+    def test_unknown_state_literal(self, tmp_path):
+        fs = _scan(tmp_path, self.MEM, self.CONSTS + """
+            def typo(mem):
+                if mem.state == "ACTVE":
+                    mem.state = RESIZED
+        """, rules=["RC008"])
+        assert ("RC008", "unknown-state:ACTVE") in _details(fs)
+
+    def test_live_membership_module_is_clean(self):
+        """The checked-in GroupMembership conforms to its own table."""
+        import tools.raycheck.protocol as proto
+        from tools.raycheck.rules import SourceModule
+
+        path = os.path.join(REPO, self.MEM)
+        with open(path) as f:
+            mod = SourceModule(path, self.MEM, f.read())
+        fs = proto.check_rc008([mod])
         assert fs == []
 
 

@@ -28,8 +28,9 @@ Blocking predicates (the bug classes PR 7 actually hit):
   time.sleep, subprocess.run/call/check_call/check_output,
   socket.create_connection / sock.recv/accept/connect,
   un-timeouted lock.acquire() / queue.get() / fut.result() /
-  ev.wait() / t.join(), loop_thread.run_coro(...), and synchronous
-  RPC ``client.call(...)`` / ``call_retrying(...)``.
+  handle.result() (async collective handles wait behind the group's
+  FIFO op queue) / ev.wait() / t.join(), loop_thread.run_coro(...),
+  and synchronous RPC ``client.call(...)`` / ``call_retrying(...)``.
 """
 
 from __future__ import annotations
@@ -80,10 +81,20 @@ def blocking_reason(mod: SourceModule, call: ast.Call) -> Optional[Tuple[str, st
     if attr == "get" and not call.args and not call.keywords and \
             ("queue" in lrecv or lrecv.endswith("_q")):
         return "queue.get", "un-timeouted Queue.get() parks the loop"
-    if attr == "result" and not call.args and not _has_timeout(call) and \
-            ("fut" in lrecv or isinstance(fn.value, ast.Call)
-             if isinstance(fn, ast.Attribute) else False):
-        return "future.result", "un-timeouted Future.result() parks the loop"
+    if attr == "result" and not call.args and not _has_timeout(call):
+        if "handle" in lrecv or "hdl" in lrecv:
+            # async collective handles: a bare .result() waits for the
+            # op AND every queued op before it on the group's FIFO
+            # worker — unbounded under backlog, so loop/handler code
+            # must always bound it
+            return "handle.result", \
+                ("un-timeouted CollectiveHandle.result() parks the loop "
+                 "behind the group's async op queue — pass a timeout "
+                 "derived from the op deadline")
+        if isinstance(fn, ast.Attribute) and \
+                ("fut" in lrecv or isinstance(fn.value, ast.Call)):
+            return "future.result", \
+                "un-timeouted Future.result() parks the loop"
     if attr == "run_coro":
         return "run_coro", ("run_coro() blocks on another loop's result — "
                             "from loop code use acall/ensure_future")

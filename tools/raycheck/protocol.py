@@ -42,6 +42,19 @@ Machines declared below:
                  warm), warmth is never revoked (BUSY_WARM→*_COLD and
                  IDLE_WARM→IDLE_COLD are illegal: the PR-7/PR-8
                  free-retry accounting keys off it).
+  * **membership** — elastic collective group membership
+                 (``util/collective/v2/membership.py``) over
+                 ``.state``: ACTIVE → DRAINING_RANK (ranks flagged by a
+                 drain event or confirmed actor death) → RESIZED
+                 (survivors adopted, epoch bumped) → ACTIVE. Epochs are
+                 monotone; the cycle only moves forward — any shortcut
+                 (ACTIVE → RESIZED without a flag pass, or a backwards
+                 edge) is a finding.
+
+State constants may be module-level names (``self.state = RESIZED``
+where ``RESIZED = "RESIZED"`` at top level): assignments and
+comparisons resolve single-assignment module string constants before
+judging, so machines don't force string literals into the runtime code.
 
 Path facts are collected per function from dominating ``if`` guards
 (both branches), early-terminal guards (``if C: return`` ⇒ ¬C after),
@@ -166,6 +179,20 @@ MACHINES: List[Machine] = [
             # warmth is never revoked: *_WARM -> *_COLD is illegal
         }),
     ),
+    Machine(
+        name="membership",
+        paths=("util/collective/v2/membership.py",),
+        receivers=("self", "mem", "m"),
+        attr="state",
+        states=frozenset({"ACTIVE", "DRAINING_RANK", "RESIZED"}),
+        transitions=frozenset({
+            # the resize cycle only moves forward; epochs bump exactly
+            # at DRAINING_RANK -> RESIZED and never decrease
+            ("ACTIVE", "DRAINING_RANK"),
+            ("DRAINING_RANK", "RESIZED"),
+            ("RESIZED", "ACTIVE"),
+        }),
+    ),
 ]
 
 
@@ -186,23 +213,56 @@ def _subject(expr: ast.expr) -> Optional[str]:
     return dotted_name(expr)
 
 
-def _facts_from(test: ast.expr, negate: bool) -> List[Fact]:
+def _module_consts(mod: SourceModule) -> Dict[str, str]:
+    """Top-level ``NAME = "STRING"`` constants, single-assignment only —
+    a rebound name is not a constant and must not resolve."""
+    consts: Dict[str, str] = {}
+    rebound: Set[str] = set()
+    for stmt in mod.tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name) and \
+                isinstance(stmt.value, ast.Constant) and \
+                isinstance(stmt.value.value, str):
+            name = stmt.targets[0].id
+            if name in consts:
+                rebound.add(name)
+            else:
+                consts[name] = stmt.value.value
+    for name in rebound:
+        consts.pop(name, None)
+    return consts
+
+
+def _resolve_str(expr: ast.expr,
+                 consts: Dict[str, str]) -> Optional[str]:
+    """String value of ``expr``: a literal, or a module-level string
+    constant name (``RESIZED`` where ``RESIZED = "RESIZED"``)."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value
+    if isinstance(expr, ast.Name):
+        return consts.get(expr.id)
+    return None
+
+
+def _facts_from(test: ast.expr, negate: bool,
+                consts: Optional[Dict[str, str]] = None) -> List[Fact]:
     """Facts established when ``test`` evaluated truthy (negate=False)
     or falsy (negate=True)."""
+    consts = consts or {}
     if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
-        return _facts_from(test.operand, not negate)
+        return _facts_from(test.operand, not negate, consts)
     if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And) \
             and not negate:
         out: List[Fact] = []
         for v in test.values:
-            out.extend(_facts_from(v, False))
+            out.extend(_facts_from(v, False, consts))
         return out
     if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.Or) \
             and negate:
         # not (a or b) == (not a) and (not b)
         out = []
         for v in test.values:
-            out.extend(_facts_from(v, True))
+            out.extend(_facts_from(v, True, consts))
         return out
     if isinstance(test, ast.Compare) and len(test.ops) == 1:
         subj = _subject(test.left)
@@ -212,14 +272,14 @@ def _facts_from(test: ast.expr, negate: bool) -> List[Fact]:
         comp = test.comparators[0]
         if isinstance(op, (ast.Eq, ast.NotEq)):
             eq = isinstance(op, ast.Eq) ^ negate
-            if isinstance(comp, ast.Constant) and \
-                    isinstance(comp.value, str):
-                return [Fact("eq" if eq else "ne", subj, comp.value)]
+            val = _resolve_str(comp, consts)
+            if val is not None:
+                return [Fact("eq" if eq else "ne", subj, val)]
         if isinstance(op, (ast.In, ast.NotIn)) and \
                 isinstance(comp, (ast.Tuple, ast.List, ast.Set)):
-            vals = [e.value for e in comp.elts
-                    if isinstance(e, ast.Constant)
-                    and isinstance(e.value, str)]
+            vals = [v for v in
+                    (_resolve_str(e, consts) for e in comp.elts)
+                    if v is not None]
             if vals and len(vals) == len(comp.elts):
                 inn = isinstance(op, ast.In) ^ negate
                 if inn and len(vals) == 1:
@@ -250,8 +310,10 @@ class _SiteCollector:
     """Walk one function body collecting (assignment-site, facts) and
     (comparison-site) entries for the machines in scope."""
 
-    def __init__(self, mod: SourceModule):
+    def __init__(self, mod: SourceModule,
+                 consts: Optional[Dict[str, str]] = None):
         self.mod = mod
+        self.consts = consts or {}
         # assignment groups: consecutive assignments to the same
         # receiver's machine attrs form ONE compound transition
         self.assigns: List[Tuple[str, Dict[str, object], int,
@@ -306,7 +368,7 @@ class _SiteCollector:
             # early-terminal guard: if C: <terminates> ⇒ ¬C afterwards
             if isinstance(stmt, ast.If) and _terminates(stmt.body) and \
                     not stmt.orelse:
-                facts.update(_facts_from(stmt.test, True))
+                facts.update(_facts_from(stmt.test, True, self.consts))
             i += 1
 
     def scope_line(self, line: int) -> str:
@@ -328,6 +390,9 @@ class _SiteCollector:
         if isinstance(stmt.value, ast.Constant) and \
                 isinstance(stmt.value.value, (str, bool)):
             return recv, t.attr, stmt.value.value
+        sval = _resolve_str(stmt.value, self.consts)
+        if sval is not None:
+            return recv, t.attr, sval
         return None
 
     @staticmethod
@@ -376,19 +441,21 @@ class _SiteCollector:
                 recv, attr = subj.rsplit(".", 1)
                 comps = []
                 c = node.comparators[0]
-                if isinstance(c, ast.Constant) and \
-                        isinstance(c.value, str):
-                    comps = [c.value]
+                v0 = _resolve_str(c, self.consts)
+                if v0 is not None:
+                    comps = [v0]
                 elif isinstance(c, (ast.Tuple, ast.List, ast.Set)):
-                    comps = [e.value for e in c.elts
-                             if isinstance(e, ast.Constant)
-                             and isinstance(e.value, str)]
+                    comps = [v for v in
+                             (_resolve_str(e, self.consts)
+                              for e in c.elts) if v is not None]
                 for v in comps:
                     self.compares.append((recv, attr, v, node.lineno, ""))
         if isinstance(stmt, ast.If):
-            then_facts = set(facts) | set(_facts_from(stmt.test, False))
+            then_facts = set(facts) | set(
+                _facts_from(stmt.test, False, self.consts))
             self._suite(stmt.body, frozenset(then_facts))
-            else_facts = set(facts) | set(_facts_from(stmt.test, True))
+            else_facts = set(facts) | set(
+                _facts_from(stmt.test, True, self.consts))
             self._suite(stmt.orelse, frozenset(else_facts))
             # a non-terminating branch may have reassigned a subject:
             # its pre-branch facts must not survive into the rest of
@@ -398,7 +465,8 @@ class _SiteCollector:
                 if b and not _terminates(b)])
             return
         if isinstance(stmt, (ast.While,)):
-            then_facts = set(facts) | set(_facts_from(stmt.test, False))
+            then_facts = set(facts) | set(
+                _facts_from(stmt.test, False, self.consts))
             self._suite(stmt.body, frozenset(then_facts))
             self._suite(stmt.orelse, frozenset(facts))
             self._invalidate_assigned_within(
@@ -519,10 +587,11 @@ def check_rc008(modules: List[SourceModule]) -> List[Finding]:
         if not any(any(p in mod.relpath for p in m.paths)
                    for m in MACHINES):
             continue
+        consts = _module_consts(mod)
         for fn in [n for n in mod.all_nodes
                    if isinstance(n, (ast.FunctionDef,
                                      ast.AsyncFunctionDef))]:
-            col = _SiteCollector(mod)
+            col = _SiteCollector(mod, consts)
             col.walk_fn(fn)
             scope = mod.scope_of(fn)
             in_init = fn.name in ("__init__", "__new__")
