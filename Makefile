@@ -4,10 +4,16 @@
 
 PYTHON ?= python3
 
-.PHONY: lint test build asan tsan clean
+.PHONY: lint test build asan tsan clean obs-dump
 
 lint:
 	$(PYTHON) -m tools.raycheck ray_tpu/ tests/
+
+# merge a run's flight-recorder shards into one Perfetto/Chrome trace:
+#   make obs-dump DIR=/tmp/ray_tpu_debug/gcs-<addr>
+DIR ?= $(firstword $(wildcard /tmp/ray_tpu_debug/*))
+obs-dump:
+	$(PYTHON) -m tools.obsdump $(DIR)
 
 test:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow'

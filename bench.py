@@ -645,6 +645,60 @@ def _run_micro_smoke() -> None:
     print("MICRO_SMOKE_JSON " + json.dumps(out))
 
 
+def _run_obs_micro() -> None:
+    """Flight-recorder overhead micro (PR 20): the cost of lifecycle
+    marks with the recorder OFF (the default every hot path pays), ON
+    (one ring append), plus the task-sampling decision and a full-ring
+    ``dump_now``. The disabled number is the one the overhead-guard
+    test budgets — instrumentation nobody asked for must be ~free."""
+    import tempfile
+
+    from ray_tpu.observability import dump as obs_dump
+    from ray_tpu.observability import events as obs_events
+    from ray_tpu.observability import timeline
+
+    out: dict = {}
+    n_off = 1_000_000
+    timeline.configure(enabled=False)
+    t0 = time.perf_counter()
+    for _ in range(n_off):
+        timeline.mark_actor("bench_actor", "submit")
+    out["mark_disabled_ns"] = round(
+        (time.perf_counter() - t0) / n_off * 1e9, 1)
+
+    timeline.configure(enabled=True, task_sample=1.0)
+    n_on = 100_000
+    t0 = time.perf_counter()
+    for _ in range(n_on):
+        timeline.mark_actor("bench_actor", "submit")
+    out["mark_enabled_us"] = round(
+        (time.perf_counter() - t0) / n_on * 1e6, 2)
+    t0 = time.perf_counter()
+    for _ in range(n_on):
+        timeline.task_sampled("aabbccdd" * 4)
+    out["task_sampled_ns"] = round(
+        (time.perf_counter() - t0) / n_on * 1e9, 1)
+    out["overhead_ratio"] = round(
+        out["mark_enabled_us"] * 1e3 / max(out["mark_disabled_ns"], 0.1),
+        1)
+
+    # dump latency with the ring at capacity (the failure-path cost)
+    with tempfile.TemporaryDirectory() as d:
+        os.environ["RAY_TPU_DEBUG_DIR"] = d
+        try:
+            t0 = time.perf_counter()
+            path = obs_dump.dump_now("bench", force=True)
+            out["dump_full_ring_ms"] = round(
+                (time.perf_counter() - t0) * 1e3, 2)
+            out["dump_shard_kb"] = round(
+                os.path.getsize(path) / 1024.0, 1) if path else None
+        finally:
+            os.environ.pop("RAY_TPU_DEBUG_DIR", None)
+    out["ring_events"] = len(obs_events.local_events())
+    timeline.configure(enabled=False)
+    print("OBS_MICRO_JSON " + json.dumps(out))
+
+
 def _run_serve_micro() -> None:
     """Serve front-door dispatch micro (PR 12): unary RTT and streaming
     chunk throughput through the HTTP proxy, measured end to end over
@@ -947,6 +1001,9 @@ def main() -> None:
         return
     if "--serve-micro" in sys.argv:
         _run_serve_micro()
+        return
+    if "--obs-micro" in sys.argv:
+        _run_obs_micro()
         return
     child_platform = os.environ.get(_CHILD_ENV)
     if child_platform == "probe":

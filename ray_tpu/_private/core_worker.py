@@ -64,7 +64,9 @@ from ray_tpu.exceptions import (
     TaskCancelledError,
     WorkerCrashedError,
 )
+from ray_tpu.observability import dump as obs_dump
 from ray_tpu.observability import events as obs_events
+from ray_tpu.observability import timeline as obs_timeline
 from ray_tpu.observability import tracing as obs_tracing
 
 logger = logging.getLogger(__name__)
@@ -650,8 +652,12 @@ class CoreWorker(CoreRuntime):
         self.server.register("StreamingCredit",
                              self._handle_streaming_credit, inline=True)
         self.server.register("Ping", lambda: "pong", inline=True)
+        # flight-recorder: the GCS fans failure dumps out to every
+        # process it can reach; drivers and workers alike answer here
+        self.server.register("DebugDump", self._handle_debug_dump)
         self.server.start(self.loop_thread)
         self.address: Tuple[str, int] = (self.server.host, self.server.port)
+        obs_dump.install("driver" if is_driver else "worker")
 
         # scheduling-strategy state
         self._node_view_cache: Optional[Tuple[float, List[dict]]] = None
@@ -689,6 +695,9 @@ class CoreWorker(CoreRuntime):
         self._actor_disp_lock = threading.Lock()
         self._pending_actor_tasks: Dict[TaskID, Dict[str, Any]] = {}
         self._actor_task_contained: Dict[TaskID, List[ObjectID]] = {}
+        # actors whose first round-trip (create → first task result) has
+        # been stamped on the lifecycle timeline already
+        self._actor_first_ping_seen: set = set()
         self._actor_pending_lock = debug_locks.maybe_wrap(
             threading.Lock(), "core_worker.CoreWorker._actor_pending_lock")
 
@@ -739,6 +748,12 @@ class CoreWorker(CoreRuntime):
             name="borrower-sweep",
         )
         t.start()
+
+    def _handle_debug_dump(self, reason: str = "requested",
+                           info: Optional[dict] = None) -> dict:
+        """GCS-initiated flight-recorder dump (failure fan-out)."""
+        path = obs_dump.dump_now(reason, extra=info)
+        return {"ok": path is not None, "path": path}
 
     # ==================================================================
     # Task events (reference: task_event_buffer.h → GcsTaskManager)
@@ -1710,6 +1725,8 @@ class CoreWorker(CoreRuntime):
             self._ref_counter().add_owned_object(oid, pending_creation=True)
         self._pending_tasks[task_id] = {"spec": spec, "retries_left": spec.max_retries}
         self._record_task_event(task_id, spec.function_descriptor.repr_name, "SUBMITTED")
+        obs_timeline.mark_task(task_id.hex(), "submit",
+                               job_id=self.job_id.hex())
         gen = self._register_stream(task_id) if streaming else None
         self.loop_thread.call_soon(self._submit_spec_threadsafe, spec)
         if streaming:
@@ -1924,6 +1941,8 @@ class CoreWorker(CoreRuntime):
                         self._maybe_request_lease(sc, spec))
             return
         entry = _LeaseEntry(reply["lease_id"], tuple(reply["worker_addr"]), granted_by)
+        obs_timeline.mark_task(spec.task_id.hex(), "lease",
+                               job_id=self.job_id.hex())
         logger.debug("lease %s granted (worker %s)", entry.lease_id[:8], entry.worker_addr)
         with self._lock:
             self._leases.setdefault(sc, []).append(entry)
@@ -2561,6 +2580,8 @@ class CoreWorker(CoreRuntime):
             self._record_task_event(
                 spec.task_id, spec.function_descriptor.repr_name,
                 "FAILED" if retriable_error else "FINISHED")
+            obs_timeline.mark_task(spec.task_id.hex(), "result",
+                                   job_id=self.job_id.hex())
             submit_ts = getattr(spec, "submit_ts", 0.0)
             if submit_ts:
                 _task_latency_histogram().observe(
@@ -2679,6 +2700,8 @@ class CoreWorker(CoreRuntime):
     # ==================================================================
     def create_actor(self, actor_class, args, kwargs, opts: ActorOptions) -> ActorID:
         actor_id = ActorID.of(self.job_id)
+        obs_timeline.mark_actor(actor_id.hex(), "submit",
+                                job_id=self.job_id.hex())
         # contained/direct arg refs stay pinned for the actor's lifetime:
         # restarts replay __init__ from the same spec (gcs_actor_manager.cc:1721)
         ser_args, ser_kwargs, _ = self._serialize_args(args, kwargs)
@@ -2909,6 +2932,12 @@ class CoreWorker(CoreRuntime):
         self._record_task_event(
             tid, info.get("method", "actor_task"),
             "FAILED" if failed else "FINISHED", kind="actor_task")
+        aid = info.get("aid")
+        if aid and aid not in self._actor_first_ping_seen \
+                and obs_timeline.enabled():
+            self._actor_first_ping_seen.add(aid)
+            obs_timeline.mark_actor(aid, "first_ping",
+                                    job_id=self.job_id.hex())
         if info.get("submit_ts"):
             _task_latency_histogram().observe(
                 max(0.0, time.time() - info["submit_ts"]),

@@ -25,6 +25,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private.config import config
 from ray_tpu._private.rpc import RpcClient, RpcServer
+from ray_tpu.observability import timeline as obs_timeline
 
 logger = logging.getLogger("ray_tpu.gcs")
 
@@ -143,6 +144,17 @@ class GcsServer:
         from ray_tpu.observability.aggregator import EventAggregator
 
         self.cluster_events = EventAggregator()
+        # the GCS's own bus events (lifecycle marks, drain/restart
+        # events) ingest through a local sink — no RPC to itself — and
+        # its shard/debug-dir identity is its own address
+        from ray_tpu.observability import dump as obs_dump
+        from ray_tpu.observability import events as obs_events
+
+        obs_events.set_process_ident("gcs")
+        obs_events.set_local_sink(self.cluster_events.add)
+        obs_dump.set_run_tag(f"127.0.0.1-{port}")
+        obs_dump.install("gcs")
+        self._last_fanout_dump = 0.0
         # graceful drain bookkeeping: per-node orchestration tasks,
         # completion events, and the bounded directory of primary
         # copies pushed off drained nodes (oid_bin -> node_id)
@@ -1004,6 +1016,7 @@ class GcsServer:
         while True:
             await asyncio.sleep(period)
             now = time.monotonic()
+            self._sample_control_plane_counters()
             for node in list(self.nodes.values()):
                 if node.alive and node.draining and \
                         now > node.drain_deadline \
@@ -1015,6 +1028,9 @@ class GcsServer:
                     logger.warning(
                         "node %s stuck DRAINING past its deadline; "
                         "force-completing", node.node_id[:12])
+                    self._debug_dump_fanout(
+                        "drain_deadline_expired", node_id=node.node_id,
+                        reason=node.drain_reason)
                     await self._finish_drain(node.node_id)
                     continue
                 if node.alive and now - node.last_heartbeat > threshold:
@@ -1163,6 +1179,7 @@ class GcsServer:
         )
         self.actors[actor_id] = actor
         self._log("actor", actor)
+        obs_timeline.mark_actor(actor_id, "registered", job_id=job_id)
         if name:
             self.named_actors[(namespace, name)] = actor_id
             self._log("named", namespace, name, actor_id)
@@ -1342,6 +1359,8 @@ class GcsServer:
         actor reached a terminal state (ALIVE or DEAD), else the retry
         delay for the caller's loop."""
         try:
+            obs_timeline.mark_actor(actor.actor_id, "scheduled",
+                                    job_id=actor.job_id, node_id=node_id)
             raylet = self._raylet(node_id)
             actor.lease_in_flight = True
             try:
@@ -1365,6 +1384,8 @@ class GcsServer:
             return 0.5
         if not reply.get("granted"):
             return 0.2
+        obs_timeline.mark_actor(actor.actor_id, "lease_granted",
+                                job_id=actor.job_id, node_id=node_id)
         worker_addr = tuple(reply["worker_addr"])
         try:
             worker = self._worker_client(worker_addr)
@@ -1395,6 +1416,8 @@ class GcsServer:
             actor.node_id = node_id
             actor.worker_id = reply.get("worker_id")
             actor.version += 1
+            obs_timeline.mark_actor(actor.actor_id, "alive",
+                                    job_id=actor.job_id, node_id=node_id)
             self._notify_actor(actor.actor_id)
             logger.info("actor %s alive on %s", actor.actor_id[:12], node_id[:12])
             return None
@@ -1530,6 +1553,11 @@ class GcsServer:
             actor.worker_addr = None
             actor.version += 1
             self._notify_actor(actor.actor_id)
+            # restarts exhausted: the black box gets persisted while the
+            # failure context is still in everyone's rings
+            self._debug_dump_fanout(
+                "actor_restarts_exhausted", actor_id=actor.actor_id,
+                job_id=actor.job_id, cause=cause)
 
     async def KillActor(self, actor_id: str, no_restart: bool = True) -> dict:
         actor = self.actors.get(actor_id)
@@ -1748,8 +1776,97 @@ class GcsServer:
 
     # -- event bus + tracing (observability/: workers push typed-event
     # batches; spans are indexed per job for GetTrace) ------------------
-    async def ReportClusterEvents(self, events: List[dict]) -> dict:
-        self.cluster_events.add(events)
+    async def ReportClusterEvents(self, events: List[dict],
+                                  clock: Optional[dict] = None) -> dict:
+        self.cluster_events.add(events, clock=clock)
+        return {"ok": True}
+
+    # -- lifecycle timelines (observability/timeline.py analysis over
+    # the aggregator's actor/task phase marks) --------------------------
+    async def ActorTimeline(self, actor_id: str) -> dict:
+        return self.cluster_events.actor_timeline(actor_id)
+
+    async def LifecycleSummary(self, job_id: Optional[str] = None,
+                               wall_s: Optional[float] = None,
+                               etype: str = "actor_lifecycle") -> dict:
+        return self.cluster_events.lifecycle_summary(
+            job_id=job_id, wall_s=wall_s, etype=etype)
+
+    # -- flight-recorder dumps (observability/dump.py) ------------------
+    def _sample_control_plane_counters(self) -> None:
+        """Counter-track samples for debug dumps: queue depths the
+        postmortem trace shows next to the event timeline."""
+        from ray_tpu.observability import dump as obs_dump
+
+        pending = sum(1 for a in self.actors.values()
+                      if a.state in ("PENDING", "RESTARTING"))
+        obs_dump.counter_sample("gcs_pending_actors", pending)
+        obs_dump.counter_sample(
+            "gcs_alive_nodes",
+            sum(1 for n in self.nodes.values() if n.alive))
+
+    def _debug_dump_fanout(self, reason: str, **info: Any) -> None:
+        """Persist the GCS's own black box and ask every reachable
+        process (raylets, job drivers, a capped set of actor workers)
+        to do the same — fire-and-forget, rate-limited."""
+        from ray_tpu.observability import dump as obs_dump
+
+        now = time.monotonic()
+        if now - self._last_fanout_dump < 5.0:
+            obs_dump.dump_now(reason, extra=info or None)
+            return
+        self._last_fanout_dump = now
+        obs_dump.dump_now(reason, extra=dict(
+            info, gcs={"actors": len(self.actors),
+                       "pending_actors": sum(
+                           1 for a in self.actors.values()
+                           if a.state in ("PENDING", "RESTARTING")),
+                       "nodes": len(self.nodes)}))
+        targets: List[Tuple[str, Any]] = []
+        for node in self.nodes.values():
+            if node.alive:
+                try:
+                    targets.append((f"raylet:{node.node_id[:12]}",
+                                    self._raylet(node.node_id)))
+                except Exception:  # noqa: BLE001 — unreachable raylet
+                    pass
+        for job in self.jobs.values():
+            if job.get("state") == "RUNNING" and job.get("driver_addr"):
+                try:
+                    targets.append((f"driver:{job['job_id'][:12]}",
+                                    self._worker_client(
+                                        tuple(job["driver_addr"]))))
+                except Exception:  # noqa: BLE001
+                    pass
+        seen_addrs = set()
+        for actor in self.actors.values():
+            if len(seen_addrs) >= 32:
+                break  # cap the worker fan-out; rings are per PROCESS
+            if actor.state == "ALIVE" and actor.worker_addr and \
+                    tuple(actor.worker_addr) not in seen_addrs:
+                seen_addrs.add(tuple(actor.worker_addr))
+                try:
+                    targets.append((f"worker:{actor.actor_id[:12]}",
+                                    self._worker_client(
+                                        tuple(actor.worker_addr))))
+                except Exception:  # noqa: BLE001
+                    pass
+
+        async def _fan() -> None:
+            for name, client in targets:
+                try:
+                    await client.acall("DebugDump", reason=reason,
+                                       info=info, timeout=5)
+                except Exception:  # noqa: BLE001 — best-effort postmortem
+                    logger.debug("debug dump to %s failed", name)
+
+        asyncio.ensure_future(_fan())
+
+    async def TriggerDebugDump(self, reason: str,
+                               info: Optional[dict] = None) -> dict:
+        """Any process that hit a typed failure asks the GCS to fan the
+        cluster-wide dump out (see observability/dump.py)."""
+        self._debug_dump_fanout(reason, **(info or {}))
         return {"ok": True}
 
     async def ListClusterEvents(self, etype: Optional[str] = None,

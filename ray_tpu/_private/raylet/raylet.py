@@ -36,6 +36,8 @@ from ray_tpu._private import debug_locks
 from ray_tpu._private.config import config
 from ray_tpu._private.ids import NodeID
 from ray_tpu._private.rpc import LoopHandle, RpcClient, RpcServer
+from ray_tpu.observability import dump as obs_dump
+from ray_tpu.observability import events as obs_events
 
 logger = logging.getLogger("ray_tpu.raylet")
 
@@ -369,15 +371,21 @@ class Raylet:
         log_path = os.path.join(self.session_dir, f"worker-{worker_id[:8]}.log")
         proc: Any = None
         zygote = self._get_zygote()
+        # spawn instant, on this host's monotonic clock: the worker
+        # attaches its age-at-CreateActor to the worker_started mark so
+        # timelines can tell a cold fork+boot from a pooled/prestarted
+        # worker without trusting a backdated stamp
+        spawn_env = {"RAY_TPU_WORKER_ID": worker_id,
+                     "RAY_TPU_WORKER_SPAWNED_MONO": repr(time.monotonic())}
         if zygote is not None:
             try:
-                pid = zygote.spawn({"RAY_TPU_WORKER_ID": worker_id},
-                                   log_path)
+                pid = zygote.spawn(spawn_env, log_path)
                 proc = ZygoteProc(pid)
             except Exception:  # noqa: BLE001
                 logger.exception("zygote spawn failed; cold spawn instead")
         if proc is None:
             env = self._worker_env(worker_id)
+            env.update(spawn_env)
             with open(log_path, "ab") as logf:
                 proc = subprocess.Popen(
                     [sys.executable, "-m",
@@ -1720,6 +1728,12 @@ class Raylet:
                     keep.append(w)
             self.idle_workers = keep
 
+    async def DebugDump(self, reason: str = "requested",
+                        info: Optional[dict] = None) -> dict:
+        """Flight-recorder shard on request (GCS fan-out / operators)."""
+        path = obs_dump.dump_now(reason, extra=info)
+        return {"ok": path is not None, "path": path}
+
     async def _register(self) -> None:
         await self.gcs.acall(
             "RegisterNode",
@@ -1754,6 +1768,13 @@ class Raylet:
         # cross-thread handoff per heartbeat/lease-path RPC
         self.gcs = RpcClient(self.gcs_addr[0], self.gcs_addr[1],
                              self._loop_handle())
+        # daemon-process observability wiring: no global_worker here, so
+        # the event flusher and dump path get their identity/transport
+        # explicitly
+        obs_events.set_process_ident(f"raylet-{self.node_id[:8]}")
+        obs_events.set_gcs_client(self.gcs)
+        obs_dump.set_run_tag(f"{self.gcs_addr[0]}:{self.gcs_addr[1]}")
+        obs_dump.install("raylet")
 
         server_task = asyncio.ensure_future(self.server.serve_forever())
         # wait until the port is bound

@@ -36,6 +36,7 @@ import struct
 import sys
 import threading
 import time
+from collections import deque
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
 from ray_tpu._private import debug_locks, fastpath
@@ -52,6 +53,28 @@ KIND_MASK = 0x7F
 
 _U32 = struct.Struct("<I")
 _U64 = struct.Struct("<Q")
+
+# Event-loop lag flight recorder: dispatches that measurably held the
+# loop (the same loop_held the slow-handler warning uses) land in a
+# bounded ring, so a failure dump can show WHEN the control plane's
+# loop was stalled and by which method — not just that a warning once
+# scrolled by. ~1 ms floor keeps the ring to genuinely interesting
+# samples; a deque append is cheap enough for the dispatch path.
+_LOOP_LAG_MIN_S = 0.001
+_loop_lag: deque = deque(maxlen=2048)
+
+
+def _note_loop_held(server: str, method: str, held_s: float,
+                    wall_s: float) -> None:
+    _loop_lag.append((time.time(), server, method,
+                      round(held_s * 1000.0, 3),
+                      round(wall_s * 1000.0, 3)))
+
+
+def loop_lag_samples() -> list:
+    """Recent loop-held samples: [{ts, server, method, held_ms, wall_ms}]."""
+    return [{"ts": t, "server": s, "method": m, "held_ms": h,
+             "wall_ms": w} for (t, s, m, h, w) in list(_loop_lag)]
 
 # Frames at or below this size coalesce: queued per-writer and flushed in
 # one transport write at the end of the current event-loop tick, so a
@@ -510,6 +533,8 @@ class RpcServer:
             flags, segs, total = _encode_body(
                 (False, f"{type(e).__name__}: {e}\n{traceback.format_exc()}"))
         dt = time.monotonic() - t0
+        if loop_held >= _LOOP_LAG_MIN_S:
+            _note_loop_held(self.name, method, loop_held, dt)
         # an inline handler's wall time inflates under process-wide GIL
         # saturation (every thread is equally stalled) — warn only well
         # past the threshold so a busy-but-healthy worker doesn't spam

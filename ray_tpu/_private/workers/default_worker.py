@@ -34,7 +34,9 @@ from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.rpc import RpcClient, get_client
 from ray_tpu._private.serialization import deserialize, loads_function, serialize
 from ray_tpu.exceptions import RayActorError, RayTaskError
+from ray_tpu.observability import dump as obs_dump
 from ray_tpu.observability import events as obs_events
+from ray_tpu.observability import timeline as obs_timeline
 from ray_tpu.observability import tracing as obs_tracing
 
 logger = logging.getLogger("ray_tpu.worker")
@@ -754,6 +756,10 @@ class WorkerServer:
         def _runner():
             with self._cancel_lock:
                 self._running_tasks[task_bin] = threading.get_ident()
+            task_hex = bytes(task_bin).hex() if obs_timeline.enabled() \
+                else ""
+            if task_hex:
+                obs_timeline.mark_task(task_hex, "run_start")
             try:
                 return _execute_callable(
                     lambda args, kwargs: fn(*args, **kwargs),
@@ -768,6 +774,8 @@ class WorkerServer:
                     submit_ts=spec_payload.get("submit_ts", 0.0),
                 )
             finally:
+                if task_hex:
+                    obs_timeline.mark_task(task_hex, "run_end")
                 with self._cancel_lock:
                     self._running_tasks.pop(task_bin, None)
 
@@ -841,6 +849,17 @@ class WorkerServer:
     def CreateActor(self, actor_id: str, serialized_spec: bytes) -> dict:
         import pickle
 
+        if obs_timeline.enabled():
+            # marked at CreateActor ARRIVAL, not backdated to fork: a
+            # prestarted/pooled worker's spawn predates the actor's
+            # whole lifecycle and would scramble the phase order.
+            # spawn_age_s distinguishes the two offline — near-zero
+            # means this lease paid for a cold fork+boot.
+            spawned = os.environ.get("RAY_TPU_WORKER_SPAWNED_MONO")
+            obs_timeline.mark_actor(
+                actor_id, "worker_started",
+                spawn_age_s=round(time.monotonic() - float(spawned), 3)
+                if spawned else None)
         spec = pickle.loads(serialized_spec)
         self._apply_py_paths(spec.get("py_paths"))
         try:
@@ -850,6 +869,7 @@ class WorkerServer:
             instance = cls(*args, **kwargs)
         except BaseException as e:  # noqa: BLE001
             return {"ok": False, "error": f"{type(e).__name__}: {e}\n{traceback.format_exc()}"}
+        obs_timeline.mark_actor(actor_id, "init_done")
         self.actors[actor_id] = _ActorRunner(actor_id, instance, spec.get("max_concurrency", 1))
         return {"ok": True}
 

@@ -33,6 +33,11 @@ Quick start (driver)::
 
 from __future__ import annotations
 
+from ray_tpu.observability.dump import (
+    counter_sample,
+    dump_now,
+    trigger_cluster_dump,
+)
 from ray_tpu.observability.events import (
     local_events,
     record_event,
@@ -41,11 +46,17 @@ from ray_tpu.observability.export import (
     export_trace,
     to_chrome_trace,
 )
+from ray_tpu.observability.schema import EVENT_TYPES
+from ray_tpu.observability.timeline import (
+    mark_actor,
+    mark_task,
+)
 from ray_tpu.observability.tracing import (
     TraceContext,
     configure,
     current_context,
     enabled,
+    seed_sampler,
     span,
 )
 
@@ -54,9 +65,16 @@ __all__ = [
     "configure",
     "current_context",
     "enabled",
+    "seed_sampler",
     "span",
     "record_event",
     "local_events",
     "to_chrome_trace",
     "export_trace",
+    "EVENT_TYPES",
+    "mark_actor",
+    "mark_task",
+    "counter_sample",
+    "dump_now",
+    "trigger_cluster_dump",
 ]

@@ -22,6 +22,21 @@ from ray_tpu.observability import tracing as obs_tracing
 # below this, ops are latency-regime noise: keep them off the event ring
 _EVENT_MIN_BYTES = 64 << 10
 
+# interned span names: the op/phase universe is tiny and fixed, so
+# building "collective.allreduce.encode" once per process (instead of an
+# f-string per call) keeps the hot path alloc-free AND keeps span names
+# out of the free-form-name trap raycheck RC009 guards against
+_SPAN_NAMES: Dict[Any, str] = {}
+
+
+def _span_name(op: str, phase: str = "") -> str:
+    key = (op, phase)
+    name = _SPAN_NAMES.get(key)
+    if name is None:
+        name = "collective." + op + ("." + phase if phase else "")
+        _SPAN_NAMES[key] = name
+    return name
+
 
 def _histogram(name: str, description: str, tag_keys):
     from ray_tpu.util.metrics import get_histogram
@@ -51,7 +66,7 @@ def op_span(op: str, nbytes: int, world_size: int, rank: int):
     rec: Dict[str, Any] = {"algo": "", "codec": "", "phases": {}}
     t0 = time.monotonic()
     with obs_tracing.span(
-            f"collective.{op}", kind="collective",
+            _span_name(op), kind="collective",
             attrs={"op": op, "nbytes": nbytes,
                    "world_size": world_size, "rank": rank}):
         yield rec
@@ -79,7 +94,7 @@ def phase_span(rec: Dict[str, Any], op: str, phase: str, nbytes: int):
     """One hierarchical phase inside an :func:`op_span`."""
     t0 = time.monotonic()
     with obs_tracing.span(
-            f"collective.{op}.{phase}", kind="collective.phase",
+            _span_name(op, phase), kind="collective.phase",
             attrs={"op": op, "phase": phase, "nbytes": nbytes}):
         yield
     dur = time.monotonic() - t0

@@ -22,11 +22,48 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 _RECENT_MAX = 2048        # flight-recorder ring (per process)
 _PENDING_MAX = 8192       # unflushed backlog cap
 _FLUSH_PERIOD_S = 0.5
+
+# daemon processes (GCS, raylet) have no global_worker: the GCS ingests
+# its own events through a local sink (no RPC to itself) and the raylet
+# injects its GCS client explicitly.
+_local_sink: Optional[Callable[[List[dict], dict], None]] = None
+_gcs_client_override: Any = None
+_ident_override: Optional[str] = None
+
+
+def set_local_sink(sink: Callable[[List[dict], dict], None]) -> None:
+    """In-process delivery (the GCS wires its aggregator here): called
+    as ``sink(batch, clock)`` with the same clock dict a remote flush
+    would carry."""
+    global _local_sink
+    _local_sink = sink
+
+
+def set_gcs_client(client: Any) -> None:
+    """Explicit GCS client for processes without a global_worker (the
+    raylet) so their rings ship instead of requeueing forever."""
+    global _gcs_client_override
+    _gcs_client_override = client
+
+
+def set_process_ident(ident: str) -> None:
+    """Stable event ``worker`` tag for daemons (e.g. "gcs", "raylet-<id>")."""
+    global _ident_override
+    _ident_override = ident
+
+
+def _gcs_client() -> Any:
+    if _gcs_client_override is not None:
+        return _gcs_client_override
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker
+    return getattr(getattr(w, "core", None), "gcs", None) if w else None
 
 
 class EventBuffer:
@@ -83,14 +120,21 @@ class EventBuffer:
     def flush_once(self) -> bool:
         """One shipping attempt; returns True when the batch reached the
         GCS (or there was nothing to ship). Unshipped events are
-        requeued so a control-plane blip loses nothing."""
-        from ray_tpu._private import worker as worker_mod
-
+        requeued so a control-plane blip loses nothing. The batch
+        carries a sender clock pair so the aggregator can reconcile the
+        events' monotonic stamps onto its own timebase."""
         batch = self.drain()
         if not batch:
             return True
-        w = worker_mod.global_worker
-        gcs = getattr(getattr(w, "core", None), "gcs", None) if w else None
+        clock = {"mono": time.monotonic(), "wall": time.time()}
+        if _local_sink is not None:
+            try:
+                _local_sink(batch, clock)
+                return True
+            except Exception:  # noqa: BLE001 — aggregator blip: requeue
+                self._requeue(batch)
+                return False
+        gcs = _gcs_client()
         if gcs is None:
             # no GCS client YET (mid-init) or ever (local mode/detached):
             # requeue so events recorded during the startup window ship
@@ -100,7 +144,8 @@ class EventBuffer:
             self._requeue(batch)
             return False
         try:
-            gcs.call_oneway("ReportClusterEvents", events=batch)
+            gcs.call_oneway("ReportClusterEvents", events=batch,
+                            clock=clock)
             return True
         except Exception:  # noqa: BLE001 — GCS blip: requeue
             self._requeue(batch)
@@ -124,6 +169,8 @@ class EventBuffer:
 
 
 def _process_ident() -> str:
+    if _ident_override is not None:
+        return _ident_override
     from ray_tpu._private import worker as worker_mod
 
     w = worker_mod.global_worker

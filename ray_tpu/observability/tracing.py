@@ -36,6 +36,32 @@ _config = {
     "sample_rate": float(os.environ.get("RAY_TPU_TRACE_SAMPLE", "1.0")),
 }
 
+# Root sampling uses a dedicated Random instance, NOT the process-global
+# random module: a seeded chaos run (PreemptionInjector) must not have
+# its injection schedule perturbed by trace sampling, and the sampling
+# itself becomes reproducible via seed_sampler()/RAY_TPU_TRACE_SEED.
+_sampler = random.Random(
+    int(os.environ["RAY_TPU_TRACE_SEED"])
+    if os.environ.get("RAY_TPU_TRACE_SEED", "").isdigit() else None)
+
+
+def seed_sampler(seed: int) -> None:
+    """Make root-span sampling decisions reproducible (chaos tests)."""
+    _sampler.seed(seed)
+
+
+# spans currently open (sampled only): span_id -> start record. Bounded
+# by the live call depth across threads; dump.py snapshots it so a
+# postmortem sees what every process was INSIDE when it died.
+_active_lock = threading.Lock()
+_active: Dict[str, dict] = {}
+
+
+def active_spans() -> list:
+    """Open sampled spans at this instant (for flight-recorder dumps)."""
+    with _active_lock:
+        return [dict(v) for v in _active.values()]
+
 # wire form: (trace_id, span_id, job_id, sampled) — a plain tuple so it
 # rides msgpack/pickle payloads without a custom serializer
 Wire = Tuple[str, str, str, bool]
@@ -159,7 +185,7 @@ def span(name: str, kind: str = "span",
             yield None
             return
         if _config["sample_rate"] < 1.0 \
-                and random.random() >= _config["sample_rate"]:
+                and _sampler.random() >= _config["sample_rate"]:
             yield None
             return
         trace_id = uuid.uuid4().hex
@@ -177,6 +203,11 @@ def span(name: str, kind: str = "span",
     ts = time.time()
     t0 = time.monotonic()
     status = "ok"
+    with _active_lock:
+        _active[ctx.span_id] = {"span_id": ctx.span_id,
+                                "trace_id": trace_id, "name": name,
+                                "kind": kind, "ts": ts,
+                                "parent_span_id": parent_span_id}
     try:
         yield ctx
     except BaseException:
@@ -184,6 +215,8 @@ def span(name: str, kind: str = "span",
         raise
     finally:
         _state.ctx = parent
+        with _active_lock:
+            _active.pop(ctx.span_id, None)
         _record_span(ctx, parent_span_id, name, kind, ts,
                      time.monotonic() - t0, status, attrs)
 

@@ -84,6 +84,20 @@ class _ProxyStats:
     def inc(self, field: str, n: int = 1) -> None:
         with self._lock:
             self._c[field] += n
+            total = self._c[field]
+        if field == "deadline_exceeded":
+            # a 504 is a typed SLO failure: leave the cluster's black box
+            # behind. Off-loop (file write) and pre-gated on the dump
+            # throttle so a 504 storm costs one thread per 5 s, not per
+            # request.
+            from ray_tpu.observability import dump as obs_dump
+
+            if obs_dump.would_dump("serve_deadline_exceeded"):
+                threading.Thread(
+                    target=obs_dump.trigger_cluster_dump,
+                    args=("serve_deadline_exceeded",),
+                    kwargs={"deadline_exceeded_total": total},
+                    daemon=True, name="obs-504-dump").start()
 
     def snapshot(self) -> Dict[str, int]:
         with self._lock:

@@ -38,6 +38,7 @@ import time
 from typing import Deque, Dict, Optional
 
 from ray_tpu.exceptions import RayTpuError
+from ray_tpu.observability import dump as obs_dump
 
 # -- defaults (env-overridable: ops knobs, not API) ---------------------
 DEFAULT_TIMEOUT_S = float(os.environ.get(
@@ -265,6 +266,7 @@ class AdmissionController:
                 return 0.0
             if len(self._queue) >= self.max_queue_depth:
                 self._shed_depth += 1
+                self._sample_shed_locked()
                 raise OverloadedError(
                     f"admission queue full "
                     f"({self.max_inflight} in flight, "
@@ -273,6 +275,7 @@ class AdmissionController:
             budget = deadline.queue_budget(self.queue_wait_s)
             if budget <= 0:
                 self._shed_timeout += 1
+                self._sample_shed_locked()
                 raise OverloadedError(
                     "no admission budget left in the request deadline",
                     retry_after_s=self._retry_after_locked())
@@ -288,7 +291,19 @@ class AdmissionController:
                 return True
             w.admitted = True  # tombstone: release() skips it
             self._shed_timeout += 1
+            self._sample_shed_locked()
             return False
+
+    def _sample_shed_locked(self) -> None:
+        """One point on the flight-recorder's shed counter track per
+        shed decision (deque append — safe under self._lock)."""
+        try:
+            obs_dump.counter_sample(
+                "serve_shed_total",
+                self._shed_depth + self._shed_timeout)
+            obs_dump.counter_sample("serve_inflight", self._inflight)
+        except Exception:  # noqa: BLE001 — diagnostics never shed harder
+            pass
 
     def _retry_after_locked(self) -> float:
         # depth-proportional hint, capped: a client that honors it

@@ -437,6 +437,54 @@ class ObjStoreGroup:
             return ()
         return tuple(res.get("dead", ()))
 
+    def _rank_failure(self, dead, epoch: int, op: str,
+                      phase: str) -> "CollectiveRankFailure":
+        """Build the typed failure AND leave a black box behind: a
+        ``collective_failure`` bus event plus a cluster-wide
+        flight-recorder dump, so the postmortem names the dead rank and
+        the op phase it died in without reproducing the run. The dead
+        rank itself can't dump (it's gone) — every survivor's shard
+        carries the attribution instead."""
+        dead = tuple(dead)
+        try:
+            obs_events.record_event(
+                "collective_failure", group=self.group_name,
+                epoch=int(epoch), rank=self.rank,
+                dead_ranks=list(dead), op=op, phase=phase)
+            from ray_tpu.observability import dump as obs_dump
+            obs_dump.trigger_cluster_dump(
+                "collective_rank_failure", group=self.group_name,
+                epoch=int(epoch), rank=self.rank,
+                dead_ranks=list(dead), op=op, phase=phase)
+        except Exception:  # noqa: BLE001 — diagnostics never mask failure
+            pass
+        return CollectiveRankFailure(dead, epoch, self.group_name,
+                                     op=op, phase=phase)
+
+    def _op_timeout_failure(self, op: str, phase: str, timeout: float,
+                            suspects) -> "CollectiveTimeoutError":
+        """Deadline exhaustion leaves the same black box as a confirmed
+        death, with the MISSING ranks tagged as suspects (the probe
+        couldn't confirm them dead) — a postmortem still opens on "who
+        was absent, in which phase" even when the authority never
+        resolved it."""
+        suspects = tuple(suspects)
+        try:
+            obs_events.record_event(
+                "collective_failure", group=self.group_name,
+                epoch=int(self._epoch), rank=self.rank,
+                suspect_ranks=list(suspects), op=op, phase=phase,
+                confirmed=False)
+            from ray_tpu.observability import dump as obs_dump
+            obs_dump.trigger_cluster_dump(
+                "collective_op_timeout", group=self.group_name,
+                epoch=int(self._epoch), rank=self.rank,
+                suspect_ranks=list(suspects), op=op, phase=phase)
+        except Exception:  # noqa: BLE001 — diagnostics never mask failure
+            pass
+        return CollectiveTimeoutError(op, phase, timeout, suspects,
+                                      self.group_name)
+
     def _fence(self) -> None:
         """Ask the authority for an epoch bump with no membership
         change: after a timeout the group's internal counters may be
@@ -492,9 +540,8 @@ class ObjStoreGroup:
             return         # the current view; waits still budget out
         members = tuple(members)
         if self.rank not in members:
-            raise CollectiveRankFailure(
-                (self.rank,), epoch, self.group_name,
-                op="membership", phase="begin_op")
+            raise self._rank_failure(
+                (self.rank,), epoch, op="membership", phase="begin_op")
         if (epoch, members) != (self._epoch, self._members):
             self._adopt(epoch, members)
 
@@ -544,9 +591,8 @@ class ObjStoreGroup:
                     waiting = [r for r in ranks if r != self.rank]
                 dead = self._probe_dead(waiting)
                 if dead:
-                    raise CollectiveRankFailure(
-                        dead, self._epoch, self.group_name,
-                        op=op or what, phase=phase)
+                    raise self._rank_failure(
+                        dead, self._epoch, op=op or what, phase=phase)
             time.sleep(nap)
             nap = min(nap * 1.5, 0.008)
         suspects: Tuple[int, ...] = ()
@@ -556,8 +602,8 @@ class ObjStoreGroup:
             except Exception:  # noqa: BLE001
                 pass
         self._fence()
-        raise CollectiveTimeoutError(op or what, phase or "collect",
-                                     timeout, suspects, self.group_name)
+        raise self._op_timeout_failure(op or what, phase or "collect",
+                                       timeout, suspects)
 
     def _guarded_wait(self, fn, op: str, phase: str, ranks=None):
         """Run a blocking shm wait (``fn(slice_timeout)``) under the op
@@ -571,17 +617,15 @@ class ObjStoreGroup:
             left = deadline - time.monotonic()
             if left <= 0:
                 self._fence()
-                raise CollectiveTimeoutError(
-                    op, phase, timeout, tuple(ranks or ()),
-                    self.group_name)
+                raise self._op_timeout_failure(
+                    op, phase, timeout, tuple(ranks or ()))
             try:
                 return fn(min(0.6, max(0.05, left)))
             except ChannelTimeoutError:
                 dead = self._probe_dead(ranks)
                 if dead:
-                    raise CollectiveRankFailure(
-                        dead, self._epoch, self.group_name,
-                        op=op, phase=phase)
+                    raise self._rank_failure(
+                        dead, self._epoch, op=op, phase=phase)
 
     # -- simulated WAN (bandwidth-capped cross-host leg) ----------------
     def _wan_stamp(self, value: Any) -> Any:
@@ -1175,9 +1219,9 @@ class ObjStoreGroup:
     def broadcast(self, tensor: Any, src_rank: int = 0) -> np.ndarray:
         self._begin_op()
         if self.world_size > 1 and src_rank not in self._members:
-            raise CollectiveRankFailure(
-                (src_rank,), self._epoch, self.group_name,
-                op="broadcast", phase="membership")
+            raise self._rank_failure(
+                (src_rank,), self._epoch, op="broadcast",
+                phase="membership")
         arr = np.ascontiguousarray(tensor)
         with obs_col.op_span("broadcast", arr.nbytes, self._eff_world,
                              self.rank) as rec:

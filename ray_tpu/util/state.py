@@ -77,6 +77,27 @@ def get_trace(job_id: str) -> Dict[str, Any]:
     return _gcs().call_retrying("GetTrace", job_id=job_id)
 
 
+def actor_timeline(actor_id: str) -> Dict[str, Any]:
+    """One actor's bring-up timeline from the control-plane lifecycle
+    marks (``RAY_TPU_TIMELINE=1``): reconciled-clock phase marks
+    (submit → registered → scheduled → lease_granted → worker_started
+    → init_done → alive → first_ping) plus the per-transition
+    durations. ``{"actor_id", "marks": [...], "transitions": [...]}``."""
+    return _gcs().call_retrying("ActorTimeline", actor_id=actor_id)
+
+
+def lifecycle_summary(job_id: Optional[str] = None,
+                      wall_s: Optional[float] = None,
+                      etype: str = "actor_lifecycle") -> Dict[str, Any]:
+    """Critical-path breakdown across every timed entity of a job:
+    per-phase p50/p99/mean plus a wall-clock attribution that sums to
+    the measured wall (``wall_s``) by construction — the scale_bench
+    many_actors per-phase row comes straight from this. ``etype`` may
+    be ``"task_lifecycle"`` for the sampled task path."""
+    return _gcs().call_retrying("LifecycleSummary", job_id=job_id,
+                                wall_s=wall_s, etype=etype)
+
+
 def list_node_stats() -> List[Dict[str, Any]]:
     """Latest per-node reporter samples (dashboard agents' reporter
     loops): cpu/mem, worker and lease counts, object-store fill."""

@@ -75,10 +75,40 @@ def _drain(refs, total_timeout: float) -> list:
     return done_vals
 
 
+def _bringup_breakdown(wall_s: float, n_actors: int):
+    """The per-phase critical path of the bring-up wall just measured:
+    poll the GCS lifecycle summary until every actor's marks have
+    flushed in (bounded), so the p50/p99 columns cover the whole fleet
+    and the wall attribution sums to the measured wall by construction.
+    None when timelines are off (RAY_TPU_TIMELINE unset)."""
+    from ray_tpu.observability import events as obs_events
+    from ray_tpu.observability import timeline as obs_timeline
+    from ray_tpu.util import state as rstate
+
+    if not obs_timeline.enabled():
+        return None
+    deadline = time.perf_counter() + 20
+    doc = None
+    while time.perf_counter() < deadline:
+        obs_events.flush()
+        try:
+            doc = rstate.lifecycle_summary(wall_s=wall_s)
+        except Exception:  # noqa: BLE001 — summary is best-effort
+            doc = None
+        if doc and doc.get("entities", 0) >= n_actors:
+            break
+        time.sleep(0.5)
+    return doc
+
+
 def bench_many_actors(n_actors: int) -> dict:
     """Create n_actors tiny actors as fast as possible, then call each
     once (the reference's many_actors measures creation + first-ping on
-    10k actors across a cluster)."""
+    10k actors across a cluster). With ``RAY_TPU_TIMELINE=1`` (the
+    default for this phase, set by ``_run_phase``) the row carries a
+    ``bringup`` breakdown attributing the creation wall to control-plane
+    phases: submit→registered→scheduled→lease_granted→worker_started→
+    init_done→alive→first_ping."""
     import ray_tpu
 
     @ray_tpu.remote(num_cpus=0)
@@ -92,18 +122,22 @@ def bench_many_actors(n_actors: int) -> dict:
     out = _drain(pings, total_timeout=1500)
     t_ready = time.perf_counter() - t0
     assert sum(out) == n_actors
+    bringup = _bringup_breakdown(t_ready, n_actors)
     t1 = time.perf_counter()
     out = _drain([a.ping.remote() for a in actors], total_timeout=900)
     t_call = time.perf_counter() - t1
     for a in actors:
         ray_tpu.kill(a)
-    return {
+    row = {
         "actors": n_actors,
         "create_and_first_ping_per_s": round(n_actors / t_ready, 1),
         "warm_call_per_s": round(n_actors / t_call, 1),
         "create_s": round(t_ready, 2),
         "phase_wall_s": round(t_ready + t_call, 2),
     }
+    if bringup is not None:
+        row["bringup"] = bringup
+    return row
 
 
 def bench_many_pgs(n_pgs: int) -> dict:
@@ -901,6 +935,12 @@ def _run_phase(phase: str, n: int, n2: int = 0) -> None:
     os.environ.setdefault("RAY_TPU_MAX_WORKERS_PER_NODE", str(n + 200))
     os.environ.setdefault("RAY_TPU_ACTOR_WAIT_ALIVE_TIMEOUT_S", "1800")
     os.environ.setdefault("RAY_TPU_ACTOR_SCHEDULE_TIMEOUT_S", "1800")
+    if phase == "many_actors":
+        # lifecycle timelines ON for the bring-up phase (must be set
+        # before init: the GCS/raylet/worker processes inherit it) —
+        # the row then carries the per-phase critical path of the
+        # creation wall
+        os.environ.setdefault("RAY_TPU_TIMELINE", "1")
     import ray_tpu
 
     if phase == "preempt_1of2_nodes":

@@ -1212,6 +1212,98 @@ class TestRC008Membership:
 
 
 # =====================================================================
+# RC009 — observability name conformance
+# =====================================================================
+
+class TestRC009:
+    SCHEMA = 'EVENT_TYPES = {"span": "s", "task_state": "t"}\n'
+
+    def _write_schema(self, tmp_path):
+        p = tmp_path / "ray_tpu" / "observability" / "schema.py"
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(self.SCHEMA)
+
+    def test_flags_undeclared_event_literal(self, tmp_path):
+        self._write_schema(tmp_path)
+        fs = _scan(tmp_path, "mod.py", """
+            from ray_tpu.observability import events as obs_events
+
+            def f():
+                obs_events.record_event("task_stat", x=1)
+        """, rules=["RC009"])
+        assert _details(fs) == [("RC009", "undeclared-event:task_stat")]
+
+    def test_declared_literal_and_variable_are_clean(self, tmp_path):
+        self._write_schema(tmp_path)
+        fs = _scan(tmp_path, "mod.py", """
+            from ray_tpu.observability import events as obs_events
+
+            def f(etype):
+                obs_events.record_event("task_state", x=1)
+                obs_events.record_event(etype, x=1)
+        """, rules=["RC009"])
+        assert fs == []
+
+    def test_flags_fstring_span_name(self, tmp_path):
+        fs = _scan(tmp_path, "mod.py", """
+            from ray_tpu.observability import tracing as obs_tracing
+
+            def f(op):
+                with obs_tracing.span(f"collective.{op}"):
+                    pass
+        """, rules=["RC009"])
+        assert _details(fs) == [("RC009", "dynamic-name:span")]
+
+    def test_flags_concat_metric_name(self, tmp_path):
+        fs = _scan(tmp_path, "mod.py", """
+            from ray_tpu.util.metrics import get_histogram
+
+            def f(kind):
+                get_histogram("lat_" + kind, description="d",
+                              boundaries=(1,), tag_keys=())
+        """, rules=["RC009"])
+        assert _details(fs) == [("RC009", "dynamic-name:get_histogram")]
+
+    def test_interned_lookup_is_clean(self, tmp_path):
+        """The sanctioned pattern: names come out of a table somebody
+        owns (observability/collective.py::_span_name)."""
+        fs = _scan(tmp_path, "mod.py", """
+            from ray_tpu.observability import tracing as obs_tracing
+
+            def _span_name(op):
+                return "collective." + op
+
+            def f(op):
+                with obs_tracing.span(_span_name(op)):
+                    pass
+        """, rules=["RC009"])
+        assert fs == []
+
+    def test_missing_schema_skips_membership_only(self, tmp_path):
+        """No schema in the analyzed tree: membership checks are
+        skipped (partial trees must stay lintable), dynamic-name checks
+        still fire."""
+        fs = _scan(tmp_path, "mod.py", """
+            from ray_tpu.observability import events as obs_events
+
+            def f(op):
+                obs_events.record_event("never_declared", x=1)
+                obs_events.record_event(f"ev.{op}", x=1)
+        """, rules=["RC009"])
+        assert _details(fs) == [("RC009", "dynamic-name:record_event")]
+
+    def test_suppression(self, tmp_path):
+        self._write_schema(tmp_path)
+        fs = _scan(tmp_path, "mod.py", """
+            from ray_tpu.observability import events as obs_events
+
+            def f():
+                obs_events.record_event("oddball")  # raycheck: disable=RC009
+        """, rules=["RC009"])
+        assert fs == []
+
+
+# =====================================================================
 # interprocedural RC001 — whole-program reachability (v2 tentpole)
 # =====================================================================
 

@@ -1,0 +1,50 @@
+"""CLI: ``python -m tools.obsdump <debug_dir> [-o trace.json]``."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tools.obsdump import load_shards, merge
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="obsdump",
+        description="Merge ray_tpu flight-recorder shards into one "
+                    "Chrome/Perfetto trace (chrome://tracing, "
+                    "ui.perfetto.dev).")
+    parser.add_argument("directory",
+                        help="debug dir, e.g. /tmp/ray_tpu_debug/gcs-…")
+    parser.add_argument("-o", "--out", default="",
+                        help="output path (default: <dir>/merged_trace"
+                             ".json)")
+    parser.add_argument("--failures-only", action="store_true",
+                        help="print the failure attribution list as "
+                             "JSON and exit")
+    args = parser.parse_args(argv)
+
+    shards = load_shards(args.directory)
+    if not shards:
+        print(f"obsdump: no shards in {args.directory}", file=sys.stderr)
+        return 1
+    doc = merge(shards)
+    if args.failures_only:
+        json.dump(doc["failures"], sys.stdout, indent=2)
+        print()
+        return 0
+    out = args.out or (args.directory.rstrip("/") + "/merged_trace.json")
+    with open(out, "w") as f:
+        json.dump(doc, f)
+    print(f"obsdump: {len(shards)} shards from "
+          f"{len(doc['processes'])} processes -> {out} "
+          f"({len(doc['traceEvents'])} trace events, "
+          f"{len(doc['failures'])} failure records)")
+    for rec in doc["failures"]:
+        print(f"  failure: {json.dumps(rec, sort_keys=True)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

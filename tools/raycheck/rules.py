@@ -397,6 +397,9 @@ RULE_DOCS: Dict[str, str] = {
              "lock",
     "RC008": "protocol-conformance: actor/node-drain/lease/pg state "
              "assignments verified against checked-in transition tables",
+    "RC009": "obs-conformance: record_event types must be declared in "
+             "observability/schema.py; event/span/metric names must not "
+             "be built with f-strings/format/concat at the call site",
 }
 
 # rules that consume the whole-program call graph (built once per run)
@@ -408,6 +411,7 @@ def builtin_rules() -> Dict[str, RuleFn]:
     from tools.raycheck.lockgraph import check_rc002
     from tools.raycheck.lockset import check_rc007
     from tools.raycheck.loopcheck import check_rc001
+    from tools.raycheck.obsconform import check_rc009
     from tools.raycheck.protocol import check_rc008
     from tools.raycheck.rpccontract import check_rc003
 
@@ -420,6 +424,7 @@ def builtin_rules() -> Dict[str, RuleFn]:
         "RC006": check_rc006,
         "RC007": check_rc007,
         "RC008": check_rc008,
+        "RC009": check_rc009,
     }
 
 
